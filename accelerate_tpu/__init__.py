@@ -1,0 +1,41 @@
+"""accelerate_tpu — a TPU-native training/inference orchestration framework.
+
+A ground-up JAX/XLA re-design with the capabilities of HuggingFace Accelerate
+(the reference at ``/root/reference``, v0.32.0.dev0): one ``Accelerator`` façade
+over device meshes, sharded data loading, compiled train steps, mixed precision,
+gradient accumulation, FSDP/ZeRO-as-sharding, checkpointing, trackers, a launch
+CLI and big-model inference — built TPU-first (SPMD meshes, pjit, pallas) rather
+than as a port of the torch wrapper design.
+"""
+
+__version__ = "0.1.0"
+
+from .accelerator import Accelerator
+from .data_loader import (
+    BatchSampler,
+    BatchSamplerShard,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SimpleDataLoader,
+    default_collate,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from .optimizer import AcceleratedOptimizer
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .train_state import DynamicLossScale, TrainState
+from .utils import (
+    DataLoaderConfiguration,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    MeshConfig,
+    ModelParallelPlugin,
+    PrecisionPolicy,
+    ProjectConfiguration,
+    ZeroPlugin,
+)
+from .utils.random import set_seed

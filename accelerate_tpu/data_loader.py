@@ -1,0 +1,869 @@
+"""Data pipeline: sharded samplers, device-placing loaders, mid-epoch resume.
+
+TPU-native re-design of reference ``src/accelerate/data_loader.py`` (1149 LoC).
+
+Host/device split (the core design change vs the reference):
+  - **Host-level IO sharding** keys off *processes* (hosts): ``BatchSamplerShard`` /
+    ``IterableDatasetShard`` reproduce the reference's index math exactly
+    (``data_loader.py:100-352``) with ``num_processes == jax.process_count()``.
+  - **Device placement** turns each per-host batch into a *global* ``jax.Array``
+    sharded over the mesh's data axes via
+    ``jax.make_array_from_process_local_data`` — replacing torch_xla's
+    ``MpDeviceLoader`` background threads (reference ``data_loader.py:518-559``)
+    with XLA's async dispatch + an optional lookahead prefetch.
+
+Works with torch ``DataLoader``s (torch is a CPU-only data dependency here), plain
+iterables, or the built-in :class:`SimpleDataLoader`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Union
+
+import jax
+import numpy as np
+
+from .parallel import mesh as mesh_lib
+from .state import GradientState, PartialState
+from .utils.dataclasses import DataLoaderConfiguration, RNGType
+from .utils.operations import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    find_batch_size,
+    recursively_apply,
+    send_to_device,
+    slice_tensors,
+)
+from .utils.random import synchronize_rng_states
+
+_PYTORCH_DATALOADER_KWARGS = (
+    "batch_size",
+    "shuffle",
+    "sampler",
+    "batch_sampler",
+    "num_workers",
+    "collate_fn",
+    "pin_memory",
+    "drop_last",
+    "timeout",
+    "worker_init_fn",
+    "multiprocessing_context",
+    "generator",
+    "prefetch_factor",
+    "persistent_workers",
+)
+
+
+class SeedableRandomSampler:
+    """Deterministic shuffling sampler, reseeded per epoch.
+
+    Reference ``SeedableRandomSampler`` (``data_loader.py:67-97``): guarantees the
+    same permutation on every process for a given (seed, epoch).
+    """
+
+    def __init__(self, data_source_len: int, seed: int = 0, epoch: int = 0):
+        self.data_source_len = data_source_len
+        self.seed = seed
+        self.epoch = epoch
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def state_dict(self):
+        return {"seed": self.seed, "epoch": self.epoch}
+
+    def load_state_dict(self, state):
+        self.seed = state["seed"]
+        self.epoch = state["epoch"]
+
+    def __len__(self):
+        return self.data_source_len
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self.epoch)
+        yield from rng.permutation(self.data_source_len).tolist()
+
+
+class BatchSampler:
+    """Minimal batch sampler (torch-free): groups a sampler's indices into batches."""
+
+    def __init__(self, sampler, batch_size: int, drop_last: bool = False):
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+
+class BatchSamplerShard:
+    """Shard a batch sampler across processes — pure index math.
+
+    Same observable behavior as reference ``BatchSamplerShard``
+    (``data_loader.py:100-253``), re-implemented by materializing the epoch's batch
+    list (inner samplers are cheap index generators):
+
+    - ``split_batches=False``: consecutive groups of ``num_processes`` batches;
+      process ``i`` takes the ``i``-th batch of each group.  With ``even_batches``
+      the index stream is cycled from the epoch's start to complete the final
+      group (so all processes see equal batch counts and full batch sizes).
+    - ``split_batches=True``: each inner batch is one *global* batch, split into
+      ``num_processes`` chunks; process ``i`` takes chunk ``i``.
+    """
+
+    def __init__(
+        self,
+        batch_sampler,
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        if split_batches and getattr(batch_sampler, "batch_size", None) is not None:
+            if batch_sampler.batch_size % num_processes != 0:
+                raise ValueError(
+                    f"To use split_batches, the batch size ({batch_sampler.batch_size}) "
+                    f"must be a round multiple of the number of processes ({num_processes})."
+                )
+        self.batch_sampler = batch_sampler
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+
+    @property
+    def total_length(self):
+        return len(self.batch_sampler)
+
+    def __len__(self):
+        if self.split_batches:
+            return len(self.batch_sampler)
+        n = len(self.batch_sampler)
+        if self.drop_last:
+            return n // self.num_processes
+        if self.even_batches:
+            return math.ceil(n / self.num_processes)
+        # uneven: processes with index < remainder get one more batch
+        full, rem = divmod(n, self.num_processes)
+        return full + (1 if self.process_index < rem else 0)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+
+    def __iter__(self):
+        if self.split_batches:
+            yield from self._iter_split()
+        else:
+            yield from self._iter_no_split()
+
+    def _iter_split(self):
+        n, i = self.num_processes, self.process_index
+        for batch in self.batch_sampler:
+            bs = len(batch)
+            full = (self.batch_size is None or bs == self.batch_size) and bs % n == 0
+            if full:
+                k = bs // n
+                yield batch[i * k : (i + 1) * k]
+                continue
+            # ragged final batch
+            if self.drop_last:
+                continue
+            if self.even_batches:
+                target = self.batch_size if self.batch_size is not None else math.ceil(bs / n) * n
+                stream = itertools.cycle(batch)
+                full_batch = list(itertools.islice(stream, target))
+                k = target // n
+                yield full_batch[i * k : (i + 1) * k]
+            else:
+                k = math.ceil(bs / n)
+                yield batch[i * k : (i + 1) * k]
+
+    def _iter_no_split(self):
+        n, i = self.num_processes, self.process_index
+        batches = list(self.batch_sampler)
+        if not batches:
+            return
+        if self.drop_last:
+            # keep only complete groups of full-size batches
+            full = [b for b in batches if self.batch_size is None or len(b) == self.batch_size]
+            for g in range(len(full) // n):
+                yield full[g * n + i]
+            return
+        if not self.even_batches:
+            for g in range(math.ceil(len(batches) / n)):
+                j = g * n + i
+                if j < len(batches):
+                    yield batches[j]
+            return
+        # even_batches: cycle the epoch's index stream from the start to complete
+        # the final group (reference behavior, data_loader.py:186-253).
+        batch_size = self.batch_size or max(len(b) for b in batches)
+        num_groups = math.ceil(len(batches) / n)
+        needed = num_groups * n * batch_size
+        stream = list(itertools.chain.from_iterable(batches))
+        cycled = itertools.islice(itertools.cycle(stream), needed)
+        flat = list(cycled)
+        rebuilt = [flat[b * batch_size : (b + 1) * batch_size] for b in range(num_groups * n)]
+        for g in range(num_groups):
+            yield rebuilt[g * n + i]
+
+
+class IterableDatasetShard:
+    """Shard an iterable dataset by buffer-and-slice.
+
+    Reference ``IterableDatasetShard`` (``data_loader.py:256-352``): buffer
+    ``batch_size * num_processes`` items, each process takes its slice.  The first
+    full buffer is retained to pad the final short buffer when ``even_batches``
+    (cycling semantics at the epoch tail).
+    """
+
+    def __init__(
+        self,
+        dataset: Iterable,
+        batch_size: int = 1,
+        drop_last: bool = False,
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self):
+        n = len(self.dataset)
+        real = self.real_batch_size * self.num_processes
+        if self.drop_last:
+            return (n // real) * self.real_batch_size
+        return math.ceil(n / real) * self.real_batch_size if self.even_batches else min(
+            self.real_batch_size, max(0, n - self.process_index * self.real_batch_size)
+        )
+
+    @property
+    def real_batch_size(self) -> int:
+        return self.batch_size // self.num_processes if self.split_batches else self.batch_size
+
+    def __iter__(self):
+        rb = self.real_batch_size
+        buffer_size = rb * self.num_processes
+        lo = self.process_index * rb
+        hi = lo + rb
+        first_buffer: Optional[List] = None
+        buffer: List = []
+        for item in self.dataset:
+            buffer.append(item)
+            if len(buffer) == buffer_size:
+                if first_buffer is None:
+                    first_buffer = list(buffer)
+                yield from buffer[lo:hi]
+                buffer = []
+        if buffer and not self.drop_last:
+            if self.even_batches:
+                pad_source = first_buffer if first_buffer is not None else buffer
+                k = 0
+                while len(buffer) < buffer_size:
+                    buffer.append(pad_source[k % len(pad_source)])
+                    k += 1
+                yield from buffer[lo:hi]
+            else:
+                yield from buffer[lo : min(hi, len(buffer))]
+
+
+class DataLoaderStateMixin:
+    """begin/end hooks registering with ``GradientState`` (reference ``data_loader.py:355-388``)."""
+
+    end_of_dataloader: bool = False
+    remainder: int = -1
+
+    def begin(self):
+        self.end_of_dataloader = False
+        self.remainder = -1
+        try:
+            length = getattr(self.base_dataloader, "total_dataset_length", len(self.dataset))
+            self.remainder = length % self.total_batch_size
+        except (TypeError, AttributeError, ZeroDivisionError):
+            pass
+        self.gradient_state._add_dataloader(self)
+
+    def end(self):
+        self.gradient_state._remove_dataloader(self)
+
+
+def _batch_to_numpy(batch):
+    def conv(x):
+        if type(x).__module__.startswith("torch"):
+            return x.detach().cpu().numpy()
+        return x
+
+    return recursively_apply(conv, batch, test_type=lambda t: True)
+
+
+class DevicePlacer:
+    """Turn per-host numpy batches into global, mesh-sharded ``jax.Array``s.
+
+    Replaces torch_xla's ``MpDeviceLoader`` (reference ``data_loader.py:518-559``):
+    dispatch is async under JAX, so simply issuing the transfer ahead of compute
+    overlaps H2D with the step; ``prefetch_size`` batches are kept in flight.
+    """
+
+    def __init__(self, mesh=None, put_on_device: bool = True):
+        self.put_on_device = put_on_device
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._mesh if self._mesh is not None else PartialState().mesh
+
+    def place(self, batch):
+        if not self.put_on_device:
+            return batch
+        batch = _batch_to_numpy(batch)
+        sharding = mesh_lib.data_sharding(self.mesh)
+        n_shards = mesh_lib.num_data_shards(self.mesh)
+        n_procs = PartialState().num_processes
+
+        def _to_global(x):
+            if not isinstance(x, (np.ndarray, jax.Array)):
+                x = np.asarray(x)
+            if x.ndim == 0 or n_shards == 1:
+                return jax.device_put(x, mesh_lib.replicated_sharding(self.mesh))
+            global_dim0 = x.shape[0] * n_procs
+            if global_dim0 % n_shards != 0:
+                # Ragged tail batch: place replicated (slower for this one batch,
+                # but shape-correct; XLA reshards inside the step as needed).
+                if n_procs > 1:
+                    raise ValueError(
+                        f"Global batch size {global_dim0} must divide the {n_shards} data shards of "
+                        f"mesh {dict(self.mesh.shape)} in multi-host mode. Use even_batches."
+                    )
+                return jax.device_put(x, mesh_lib.replicated_sharding(self.mesh))
+            if n_procs == 1:
+                return jax.device_put(x, sharding)
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return recursively_apply(_to_global, batch)
+
+
+class DataLoaderShard(DataLoaderStateMixin):
+    """Per-process loader: RNG sync at iter start, final-batch lookahead, device placement.
+
+    Reference ``DataLoaderShard`` (``data_loader.py:391-515``).
+    """
+
+    def __init__(
+        self,
+        base_dataloader,
+        device=None,
+        rng_types: Optional[List[RNGType]] = None,
+        synchronized_generator=None,
+        skip_batches: int = 0,
+        put_on_device: bool = True,
+        prefetch_size: int = 2,
+        mesh=None,
+        _drop_last: bool = False,
+        _non_blocking: bool = False,
+        **kwargs,
+    ):
+        self.base_dataloader = base_dataloader
+        self.device = device
+        self.rng_types = rng_types
+        self.synchronized_generator = synchronized_generator
+        self.skip_batches = skip_batches
+        self.gradient_state = GradientState()
+        self.placer = DevicePlacer(mesh=mesh, put_on_device=put_on_device)
+        self.prefetch_size = max(1, prefetch_size)
+        self.iteration = 0
+
+    # pass-through attribute access to the wrapped loader (dataset, batch_size, ...)
+    def __getattr__(self, name):
+        if name == "base_dataloader":
+            raise AttributeError(name)
+        return getattr(self.base_dataloader, name)
+
+    def __len__(self):
+        return len(self.base_dataloader)
+
+    @property
+    def dataset(self):
+        return getattr(self.base_dataloader, "dataset", None)
+
+    @property
+    def total_batch_size(self) -> int:
+        """Observed global batch size per step (reference ``data_loader.py:497-507``)."""
+        sampler = getattr(self.base_dataloader, "batch_sampler", None) or getattr(
+            self.base_dataloader, "sampler", None
+        )
+        if isinstance(sampler, BatchSamplerShard):
+            if sampler.split_batches:
+                return sampler.batch_size or 0
+            return (sampler.batch_size or 0) * sampler.num_processes
+        bs = getattr(self.base_dataloader, "batch_size", None) or 0
+        return bs * PartialState().num_processes
+
+    @property
+    def total_dataset_length(self):
+        dataset = self.dataset
+        return len(dataset) if dataset is not None and hasattr(dataset, "__len__") else None
+
+    def set_epoch(self, epoch: int):
+        self.iteration = epoch
+        if hasattr(self.base_dataloader, "set_epoch"):
+            self.base_dataloader.set_epoch(epoch)
+        sampler = getattr(self.base_dataloader, "batch_sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+
+    def __iter__(self):
+        if self.rng_types is not None:
+            synchronize_rng_states(self.rng_types, self.synchronized_generator)
+        self.begin()
+        self.set_epoch(self.iteration)
+        try:
+            raw_iter = iter(self.base_dataloader)
+            if self.skip_batches:
+                raw_iter = itertools.islice(raw_iter, self.skip_batches, None)
+            # Lookahead of `prefetch_size`: transfers for future batches are issued
+            # (async) while the current batch computes; the final batch is detected
+            # one step early so GradientState can force a gradient sync
+            # (reference one-batch lookahead, data_loader.py:445-476).
+            window: List[Any] = []
+            exhausted = False
+            while not exhausted and len(window) < self.prefetch_size:
+                try:
+                    window.append(self.placer.place(next(raw_iter)))
+                except StopIteration:
+                    exhausted = True
+            while window:
+                if exhausted and len(window) == 1:
+                    self.end_of_dataloader = True
+                current = window.pop(0)
+                if not exhausted:
+                    try:
+                        window.append(self.placer.place(next(raw_iter)))
+                    except StopIteration:
+                        exhausted = True
+                yield current
+            self.iteration += 1
+        finally:
+            self.end()
+
+
+class DataLoaderDispatcher(DataLoaderStateMixin):
+    """Process 0 loads; batches are broadcast then sliced per process.
+
+    Reference ``DataLoaderDispatcher`` (``data_loader.py:562-776``): for datasets
+    only process 0 can read (streaming).  Non-main processes iterate structure-only.
+    """
+
+    def __init__(
+        self,
+        base_dataloader,
+        split_batches: bool = False,
+        skip_batches: int = 0,
+        put_on_device: bool = True,
+        prefetch_size: int = 2,
+        mesh=None,
+        slice_fn=None,
+        **kwargs,
+    ):
+        self.base_dataloader = base_dataloader
+        self.split_batches = split_batches
+        self.skip_batches = skip_batches
+        self.state = PartialState()
+        self.gradient_state = GradientState()
+        self.placer = DevicePlacer(mesh=mesh, put_on_device=put_on_device)
+        self.slice_fn = slice_fn or slice_tensors
+        self.iteration = 0
+
+    def __getattr__(self, name):
+        if name == "base_dataloader":
+            raise AttributeError(name)
+        return getattr(self.base_dataloader, name)
+
+    @property
+    def dataset(self):
+        return getattr(self.base_dataloader, "dataset", None)
+
+    @property
+    def total_batch_size(self) -> int:
+        bs = getattr(self.base_dataloader, "batch_size", None) or 0
+        return bs if self.split_batches else bs * self.state.num_processes
+
+    @property
+    def total_dataset_length(self):
+        dataset = self.dataset
+        return len(dataset) if dataset is not None and hasattr(dataset, "__len__") else None
+
+    def __len__(self):
+        n = len(self.base_dataloader)
+        if self.split_batches:
+            return n
+        return math.ceil(n / self.state.num_processes)
+
+    def set_epoch(self, epoch: int):
+        self.iteration = epoch
+        if hasattr(self.base_dataloader, "set_epoch"):
+            self.base_dataloader.set_epoch(epoch)
+
+    def _fetch_and_broadcast(self, raw_iter) -> Optional[Any]:
+        """Main process fetches a global batch; everyone receives it."""
+        if self.state.is_main_process:
+            if self.split_batches:
+                try:
+                    batch = _batch_to_numpy(next(raw_iter))
+                except StopIteration:
+                    batch = None
+            else:
+                # Concatenate num_processes per-process batches into one global batch.
+                parts = []
+                for _ in range(self.state.num_processes):
+                    try:
+                        parts.append(_batch_to_numpy(next(raw_iter)))
+                    except StopIteration:
+                        break
+                batch = concatenate(parts, dim=0) if parts else None
+            info = [None if batch is None else jax.tree_util.tree_structure(batch)]
+        else:
+            batch, info = None, [None]
+        if self.state.num_processes > 1:
+            broadcast_object_list(info, from_process=0)
+            if info[0] is None:
+                return None
+            if not self.state.is_main_process:
+                batch = None
+            batch = _broadcast_batch(batch, info[0], self.state)
+        return batch
+
+    def _local_slice(self, batch):
+        """Each process keeps its contiguous chunk of the broadcast global batch."""
+        if self.state.num_processes == 1:
+            return batch
+        observed = find_batch_size(batch)
+        if observed % self.state.num_processes != 0:
+            raise ValueError(
+                f"Dispatched global batch of {observed} does not divide {self.state.num_processes} "
+                "processes; use even_batches or a divisible batch size."
+            )
+        chunk = observed // self.state.num_processes
+        lo = self.state.process_index * chunk
+        return self.slice_fn(batch, slice(lo, lo + chunk))
+
+    def __iter__(self):
+        self.begin()
+        self.set_epoch(self.iteration)
+        raw_iter = iter(self.base_dataloader) if self.state.is_main_process else iter(())
+        if self.skip_batches and self.state.is_main_process:
+            skip = self.skip_batches * (1 if self.split_batches else self.state.num_processes)
+            raw_iter = itertools.islice(raw_iter, skip, None)
+        try:
+            batch = self._fetch_and_broadcast(raw_iter)
+            while batch is not None:
+                next_batch = self._fetch_and_broadcast(raw_iter)
+                if next_batch is None:
+                    self.end_of_dataloader = True
+                    observed = find_batch_size(batch)
+                    self.remainder = observed % self.total_batch_size if self.total_batch_size else -1
+                yield self.placer.place(self._local_slice(batch))
+                batch = next_batch
+            self.iteration += 1
+        finally:
+            self.end()
+
+
+def _broadcast_batch(batch, treedef, state):
+    """Broadcast a pytree batch from process 0 (structure already agreed)."""
+    if state.is_main_process:
+        leaves = jax.tree_util.tree_leaves(batch)
+        meta = [(l.shape, str(l.dtype)) for l in leaves]
+    else:
+        meta = None
+    payload = [meta]
+    broadcast_object_list(payload, from_process=0)
+    meta = payload[0]
+    if state.is_main_process:
+        out_leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(batch)]
+    else:
+        out_leaves = [np.zeros(shape, dtype=dtype) for shape, dtype in meta]
+    out_leaves = [broadcast(l, from_process=0) for l in out_leaves]
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+class SimpleDataLoader:
+    """Torch-free map-style loader: dataset + (batch_)sampler + collate into numpy stacks."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: Optional[int] = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        batch_sampler=None,
+        sampler=None,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", None)
+            self.drop_last = getattr(batch_sampler, "drop_last", False)
+        else:
+            if sampler is None:
+                sampler = (
+                    SeedableRandomSampler(len(dataset), seed=seed) if shuffle else range(len(dataset))
+                )
+            self.sampler = sampler
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.batch_sampler = BatchSampler(sampler, batch_size, drop_last)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+
+    def __len__(self):
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        for batch_indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in batch_indices])
+
+
+def default_collate(items: List[Any]):
+    """Stack a list of samples into a batch (numpy), recursing into dicts/tuples."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: default_collate([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([it[i] for it in items]) for i in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])
+
+
+def _is_torch_loader(obj) -> bool:
+    try:
+        import torch.utils.data as tud
+
+        return isinstance(obj, tud.DataLoader)
+    except ImportError:
+        return False
+
+
+def prepare_data_loader(
+    dataloader,
+    device=None,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+    split_batches: bool = False,
+    put_on_device: bool = True,
+    rng_types: Optional[List[RNGType]] = None,
+    dispatch_batches: Optional[bool] = None,
+    even_batches: bool = True,
+    slice_fn_for_dispatch=None,
+    use_seedable_sampler: bool = False,
+    non_blocking: bool = False,
+    prefetch_size: int = 2,
+    mesh=None,
+) -> Union[DataLoaderShard, DataLoaderDispatcher]:
+    """Wrap a dataloader for distributed TPU training (reference ``data_loader.py:797-1034``).
+
+    Accepts a torch ``DataLoader``, a :class:`SimpleDataLoader`, or any iterable of
+    batches.  Sharding is at *host* granularity; device placement shards the global
+    batch over the mesh's data axes.
+    """
+    state = PartialState()
+    num_processes = num_processes if num_processes is not None else state.num_processes
+    process_index = process_index if process_index is not None else state.process_index
+    if dispatch_batches is None:
+        dispatch_batches = False
+
+    if dispatch_batches:
+        return DataLoaderDispatcher(
+            dataloader,
+            split_batches=split_batches,
+            put_on_device=put_on_device,
+            prefetch_size=prefetch_size,
+            mesh=mesh,
+            slice_fn=slice_fn_for_dispatch,
+        )
+
+    synchronized_generator = None
+    new_loader = dataloader
+    if num_processes > 1 and (_is_torch_loader(dataloader) or isinstance(dataloader, SimpleDataLoader)):
+        batch_sampler = getattr(dataloader, "batch_sampler", None)
+        if batch_sampler is not None and not isinstance(batch_sampler, BatchSamplerShard):
+            sharded = BatchSamplerShard(
+                batch_sampler,
+                num_processes=num_processes,
+                process_index=process_index,
+                split_batches=split_batches,
+                even_batches=even_batches,
+            )
+            new_loader = _rebuild_with_batch_sampler(dataloader, sharded)
+        elif batch_sampler is None:
+            # Iterable-style dataset (torch DataLoader over IterableDataset):
+            # shard at the item level by buffer-and-slice.
+            dataset = getattr(dataloader, "dataset", None)
+            batch_size = getattr(dataloader, "batch_size", 1) or 1
+            if dataset is not None:
+                sharded_ds = IterableDatasetShard(
+                    dataset,
+                    batch_size=batch_size,
+                    drop_last=getattr(dataloader, "drop_last", False),
+                    num_processes=num_processes,
+                    process_index=process_index,
+                    split_batches=split_batches,
+                    even_batches=even_batches,
+                )
+                new_loader = _rebuild_with_dataset(
+                    dataloader,
+                    sharded_ds,
+                    batch_size=batch_size // num_processes if split_batches else batch_size,
+                )
+    if use_seedable_sampler and isinstance(new_loader, SimpleDataLoader):
+        synchronized_generator = getattr(new_loader.batch_sampler, "sampler", None)
+
+    return DataLoaderShard(
+        new_loader,
+        device=device,
+        rng_types=rng_types,
+        synchronized_generator=synchronized_generator,
+        put_on_device=put_on_device,
+        prefetch_size=prefetch_size,
+        mesh=mesh,
+    )
+
+
+def _rebuild_with_dataset(dataloader, dataset, batch_size: int):
+    import torch.utils.data as tud
+
+    kwargs = {}
+    for k in _PYTORCH_DATALOADER_KWARGS:
+        if k in ("batch_size", "shuffle", "sampler", "batch_sampler", "dataset"):
+            continue
+        if hasattr(dataloader, k):
+            v = getattr(dataloader, k)
+            if k == "prefetch_factor" and v is None:
+                continue
+            kwargs[k] = v
+    return tud.DataLoader(dataset, batch_size=batch_size, **kwargs)
+
+
+def _rebuild_with_batch_sampler(dataloader, batch_sampler):
+    if isinstance(dataloader, SimpleDataLoader):
+        return SimpleDataLoader(
+            dataloader.dataset, collate_fn=dataloader.collate_fn, batch_sampler=batch_sampler
+        )
+    import torch.utils.data as tud
+
+    kwargs = {}
+    for k in _PYTORCH_DATALOADER_KWARGS:
+        if k in ("batch_size", "shuffle", "sampler", "batch_sampler", "drop_last"):
+            continue
+        if hasattr(dataloader, k):
+            v = getattr(dataloader, k)
+            if k == "prefetch_factor" and v is None:
+                continue
+            kwargs[k] = v
+    return tud.DataLoader(dataloader.dataset, batch_sampler=batch_sampler, **kwargs)
+
+
+class SkipBatchSampler:
+    """Batch sampler skipping the first ``skip_batches`` (reference ``data_loader.py:1037-1066``)."""
+
+    def __init__(self, batch_sampler, skip_batches: int = 0):
+        self.batch_sampler = batch_sampler
+        self.skip_batches = skip_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+
+    def __iter__(self):
+        yield from itertools.islice(iter(self.batch_sampler), self.skip_batches, None)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+
+    @property
+    def total_length(self):
+        return len(self.batch_sampler)
+
+    def __len__(self):
+        return len(self.batch_sampler) - self.skip_batches
+
+
+class SkipDataLoader:
+    """Iterable skipping the first batches (reference ``data_loader.py:1069-1080``)."""
+
+    def __init__(self, dataloader, skip_batches: int = 0):
+        self.dataloader = dataloader
+        self.skip_batches = skip_batches
+
+    def __getattr__(self, name):
+        if name == "dataloader":
+            raise AttributeError(name)
+        return getattr(self.dataloader, name)
+
+    def __iter__(self):
+        yield from itertools.islice(iter(self.dataloader), self.skip_batches, None)
+
+    def __len__(self):
+        return len(self.dataloader) - self.skip_batches
+
+
+def skip_first_batches(dataloader, num_batches: int = 0):
+    """Mid-epoch resume: a loader skipping ``num_batches`` (reference ``data_loader.py:1082-1148``)."""
+    if isinstance(dataloader, DataLoaderDispatcher):
+        return DataLoaderDispatcher(
+            dataloader.base_dataloader,
+            split_batches=dataloader.split_batches,
+            skip_batches=num_batches,
+            put_on_device=dataloader.placer.put_on_device,
+            mesh=dataloader.placer._mesh,
+            slice_fn=dataloader.slice_fn,
+        )
+    if isinstance(dataloader, DataLoaderShard):
+        return DataLoaderShard(
+            dataloader.base_dataloader,
+            device=dataloader.device,
+            rng_types=dataloader.rng_types,
+            synchronized_generator=dataloader.synchronized_generator,
+            skip_batches=num_batches,
+            put_on_device=dataloader.placer.put_on_device,
+            prefetch_size=dataloader.prefetch_size,
+            mesh=dataloader.placer._mesh,
+        )
+    return SkipDataLoader(dataloader, skip_batches=num_batches)

@@ -1,0 +1,67 @@
+"""Optimizer wrapper.
+
+TPU-native analog of reference ``src/accelerate/optimizer.py`` (214 LoC,
+``AcceleratedOptimizer``).  The reference wrapper intercepts ``step``/``zero_grad``
+to (a) skip when accumulating, (b) run the GradScaler overflow dance, (c) all-reduce
+grads on XLA (``optimizer.py:140-146``).  All three live *inside* the compiled train
+step here (``Accelerator.compile_train_step``); this wrapper is the descriptive
+shell that carries the optax transformation, learning-rate schedule and bookkeeping
+the user-facing API needs (``optimizer.step_was_skipped``, hyperparameter access,
+state save/load).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedOptimizer:
+    def __init__(
+        self,
+        optimizer: Union[optax.GradientTransformation, "AcceleratedOptimizer"],
+        scheduler: Optional[Callable[[int], float]] = None,
+    ):
+        if isinstance(optimizer, AcceleratedOptimizer):
+            optimizer = optimizer.optimizer
+        if not isinstance(optimizer, optax.GradientTransformation):
+            raise TypeError(
+                f"Accelerator.prepare expected an optax.GradientTransformation, got {type(optimizer)}"
+            )
+        self.optimizer = optimizer
+        self.scheduler = scheduler
+        self.gradient_state = GradientState()
+        self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
+        self._step_was_skipped = False
+        self._accumulated = None  # imperative-mode grad buffer
+
+    # ------------------------------------------------------------- optax API
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.optimizer.update(grads, state, params)
+
+    @property
+    def tx(self) -> optax.GradientTransformation:
+        return self.optimizer
+
+    # ----------------------------------------------------- reference parity
+    @property
+    def step_was_skipped(self) -> bool:
+        """True when the last step overflowed under fp16 (reference ``optimizer.py:209-214``)."""
+        return self._step_was_skipped
+
+    def zero_grad(self, set_to_none: bool = True):
+        """No-op for parity: grads are function outputs, never module state."""
+        self._accumulated = None
+
+    def state_dict(self):
+        raise NotImplementedError(
+            "Optimizer state lives in the TrainState pytree; use accelerator.save_state()."
+        )

@@ -1,0 +1,12 @@
+"""Parallelism substrate: named meshes, sharding rules, collectives, model parallel."""
+
+from .mesh import (
+    DATA_AXES,
+    MESH_AXES,
+    build_mesh,
+    data_partition_spec,
+    data_sharding,
+    mesh_axis_size,
+    num_data_shards,
+    replicated_sharding,
+)

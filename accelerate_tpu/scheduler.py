@@ -1,0 +1,48 @@
+"""LR-schedule wrapper.
+
+TPU-native analog of reference ``src/accelerate/scheduler.py`` (98 LoC,
+``AcceleratedScheduler``).  Reference semantics preserved:
+
+  - the schedule advances only on *applied* optimizer steps — automatic here,
+    because ``TrainState.step`` increments only when an update is applied (grad
+    accumulation and fp16-overflow skips never advance it);
+  - when ``split_batches=False`` the reference steps the scheduler
+    ``num_processes`` times per optimizer step (``scheduler.py:66-82``) so that LR
+    schedules written for single-process global step counts stay correct; here
+    that is a step-count multiplier on the wrapped optax schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+import optax
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        schedule: Union[Callable[[int], float], float],
+        step_multiplier: int = 1,
+        split_batches: bool = False,
+    ):
+        if isinstance(schedule, (int, float)):
+            value = float(schedule)
+            schedule = lambda count: value  # noqa: E731
+        self.schedule = schedule
+        self.split_batches = split_batches
+        self.step_multiplier = 1 if split_batches else max(1, step_multiplier)
+
+    def __call__(self, count):
+        return self.schedule(count * self.step_multiplier)
+
+    def get_last_lr(self, step: int):
+        return [float(self(step))]
+
+    def state_dict(self):
+        return {"step_multiplier": self.step_multiplier, "split_batches": self.split_batches}
+
+    def load_state_dict(self, state):
+        self.step_multiplier = state.get("step_multiplier", self.step_multiplier)
+        self.split_batches = state.get("split_batches", self.split_batches)

@@ -1,0 +1,112 @@
+"""Functional training state — the compiled-step analog of the reference's wrapped objects.
+
+The reference mutates ``model``/``optimizer`` objects in place and patches
+``forward`` (``accelerator.py:1327-1576``).  In JAX all mutable training state lives
+in one pytree that flows through a compiled step function.  ``TrainState`` carries:
+
+  - ``params``        master weights (``PrecisionPolicy.param_dtype``)
+  - ``opt_state``     optax state (sharded like params)
+  - ``grad_accum``    cross-call gradient accumulation buffer (reference
+                      ``accumulate()``/``sync_gradients`` semantics compiled in)
+  - ``loss_scale``    dynamic fp16 loss scale (reference GradScaler,
+                      ``accelerator.py:454-481``)
+  - ``rng``           jax PRNG key, split per step
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class DynamicLossScale(struct.PyTreeNode):
+    """GradScaler analog (reference wires torch GradScaler; ``optimizer.py:153-168``).
+
+    Scale grows by ``growth_factor`` after ``growth_interval`` consecutive finite
+    steps and backs off by ``backoff_factor`` on overflow; overflow steps are
+    skipped (the reference's ``step_was_skipped``).
+    """
+
+    scale: jax.Array
+    growth_tracker: jax.Array
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**16, **kwargs) -> "DynamicLossScale":
+        return cls(
+            scale=jnp.asarray(init_scale, dtype=jnp.float32),
+            growth_tracker=jnp.zeros((), dtype=jnp.int32),
+            **kwargs,
+        )
+
+    def update(self, grads_finite: jax.Array) -> "DynamicLossScale":
+        tracker = jnp.where(grads_finite, self.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.scale * self.growth_factor, self.scale),
+            jnp.maximum(self.scale * self.backoff_factor, 1.0),
+        )
+        return self.replace(scale=new_scale, growth_tracker=jnp.where(grow, 0, tracker))
+
+
+def tree_finite(tree) -> jax.Array:
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+def global_norm(tree) -> jax.Array:
+    return optax.global_norm(tree)
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array                      # count of *applied* optimizer steps
+    micro_step: jax.Array                # count of micro (per-call) steps
+    params: Any
+    opt_state: Any
+    grad_accum: Any                      # None when gradient_accumulation_steps == 1
+    loss_scale: Optional[DynamicLossScale]
+    rng: Optional[jax.Array]
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        apply_fn: Optional[Callable] = None,
+        params,
+        tx: optax.GradientTransformation,
+        gradient_accumulation_steps: int = 1,
+        use_loss_scaling: bool = False,
+        init_loss_scale: float = 2.0**16,
+        rng: Optional[jax.Array] = None,
+    ) -> "TrainState":
+        opt_state = tx.init(params)
+        grad_accum = (
+            jax.tree_util.tree_map(jnp.zeros_like, params) if gradient_accumulation_steps > 1 else None
+        )
+        return cls(
+            step=jnp.zeros((), dtype=jnp.int32),
+            micro_step=jnp.zeros((), dtype=jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            grad_accum=grad_accum,
+            loss_scale=DynamicLossScale.create(init_loss_scale) if use_loss_scaling else None,
+            rng=rng,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(params=new_params, opt_state=new_opt_state, step=self.step + 1)

@@ -1,0 +1,42 @@
+"""Loader for the optional C++ runtime extension (built from ``native/``).
+
+The extension provides an mmap-backed safetensors reader and a prefetching batch
+pipeline (see ``native/README.md``).  Pure-Python fallbacks exist for every entry
+point, so the framework works without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import os
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _find_library() -> Optional[str]:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = glob.glob(os.path.join(root, "native", "libatpu_runtime*.so")) + glob.glob(
+        os.path.join(root, "native", "build", "libatpu_runtime*.so")
+    )
+    return candidates[0] if candidates else None
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _find_library()
+    if path is not None:
+        try:
+            _LIB = ctypes.CDLL(path)
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def is_available() -> bool:
+    return get_library() is not None

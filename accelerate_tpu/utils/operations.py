@@ -1,0 +1,465 @@
+"""Pytree tensor operations & host-level collectives.
+
+TPU-native re-design of the reference's ``src/accelerate/utils/operations.py`` (848
+LoC).  The reference implements per-backend collectives (``_gpu_gather`` /
+``_tpu_gather``, ``operations.py:308-358``) applied over pytrees via
+``recursively_apply`` (``:84-133``).  Here there are two distinct layers:
+
+1. **In-step collectives** (inside ``jit``/``shard_map``) are XLA ops — see
+   ``accelerate_tpu.parallel.collectives``.  Most reference call-sites (grad
+   all-reduce, loss averaging) disappear into the compiled step: XLA emits them
+   from shardings.
+
+2. **Host-level operations** (this module) work on *materialized* values between
+   steps: ``gather``/``reduce``/``broadcast``/``pad_across_processes`` over pytrees of
+   JAX arrays / numpy arrays, plus pickle-based object collectives
+   (``gather_object``/``broadcast_object_list``,  reference ``:444-467,566-584``)
+   built on ``jax.experimental.multihost_utils``.
+
+Semantic mapping: a reference per-rank tensor of shape ``[b, ...]`` corresponds here
+to either (a) a *global* ``jax.Array`` of shape ``[world*b, ...]`` sharded over the
+data axes — ``gather`` materializes the full value, ``reduce`` folds the shard dim —
+or (b) a host-local numpy value per process, gathered/reduced across processes.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Callable, List, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import mesh as mesh_lib
+
+try:  # moved across JAX versions
+    from jax.experimental import multihost_utils
+except ImportError:  # pragma: no cover
+    multihost_utils = None
+
+
+def PartialState():
+    """Lazy accessor (avoids a circular import with ``accelerate_tpu.state``)."""
+    from ..state import PartialState as _PartialState
+
+    return _PartialState()
+
+
+class DistributedOperationException(Exception):
+    """Raised when an operation would deadlock due to cross-process shape mismatch.
+
+    Reference: ``utils/operations.py:361-421`` (``verify_operation`` under
+    ``ACCELERATE_DEBUG_MODE``).
+    """
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) or (
+        type(x).__module__ == "torch" and type(x).__name__ == "Tensor"
+    )
+
+
+def _to_numpy(x) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    if isinstance(x, jax.Array):
+        return np.asarray(jax.device_get(x))
+    if type(x).__module__.startswith("torch"):
+        return x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def honor_type(obj, generator):
+    """Rebuild ``obj``'s container type from ``generator`` (reference ``operations.py:73``)."""
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*list(generator))
+    return type(obj)(generator)
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = is_tensor,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every tensor leaf of a nested structure.
+
+    Port of the reference's pytree recursion (``operations.py:84-133``): handles
+    list/tuple/namedtuple/dict (order-preserving) and leaves non-tensor leaves
+    untouched unless ``error_on_other_type``.
+    """
+    if isinstance(data, (tuple, list)):
+        return honor_type(
+            data,
+            (
+                recursively_apply(
+                    func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for o in data
+            ),
+        )
+    if isinstance(data, Mapping):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for k, v in data.items()
+            }
+        )
+    if test_type(data):
+        return func(data, *args, **kwargs)
+    if error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed to {getattr(func, '__name__', func)}: only nested "
+            "list/tuple/dict of arrays are supported."
+        )
+    return data
+
+
+# --------------------------------------------------------------------- device io
+def send_to_device(tensor, device=None, non_blocking: bool = False, skip_keys=None):
+    """Move a pytree onto device(s) (reference ``operations.py:140-192``).
+
+    ``device`` may be a ``jax.Device``, a ``Sharding`` (placement across the mesh),
+    or ``None`` (default device).  torch tensors are converted via numpy.
+    """
+    if isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+
+    def _send(t):
+        t = _as_jax_compatible(t)
+        if device is None:
+            return jnp.asarray(t)
+        return jax.device_put(t, device)
+
+    if isinstance(tensor, Mapping) and skip_keys:
+        return type(tensor)(
+            {k: (v if k in skip_keys else send_to_device(v, device, non_blocking)) for k, v in tensor.items()}
+        )
+    return recursively_apply(_send, tensor)
+
+
+def _as_jax_compatible(t):
+    if type(t).__module__.startswith("torch"):
+        return t.detach().cpu().numpy()
+    return t
+
+
+# ------------------------------------------------------------------- inspection
+def find_device(data):
+    """First device found in a pytree (reference ``operations.py:830-848``)."""
+    for leaf in jax.tree_util.tree_leaves(data):
+        if isinstance(leaf, jax.Array):
+            devs = getattr(leaf.sharding, "device_set", None)
+            if devs:
+                return next(iter(devs))
+    return None
+
+
+def find_batch_size(data) -> Optional[int]:
+    """Batch size (dim 0) of the first tensor leaf (reference ``operations.py:254-274``)."""
+    for leaf in jax.tree_util.tree_leaves(data):
+        if is_tensor(leaf) and getattr(leaf, "ndim", 0) >= 1:
+            return leaf.shape[0]
+    raise ValueError("Cannot find the batch size from empty data.")
+
+
+def ignorant_find_batch_size(data) -> Optional[int]:
+    try:
+        return find_batch_size(data)
+    except (ValueError, TypeError):
+        return None
+
+
+def listify(data):
+    """Convert tensor leaves to nested Python lists (reference ``operations.py:277-290``)."""
+
+    def _listify(t):
+        return _to_numpy(t).tolist()
+
+    return recursively_apply(_listify, data)
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Slice every tensor leaf (reference ``operations.py:588-599``)."""
+
+    def _slice(t):
+        return t[tensor_slice]
+
+    return recursively_apply(_slice, data)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of same-structured pytrees leafwise (reference ``operations.py:602-620``)."""
+    first = data[0]
+    if isinstance(first, (tuple, list)):
+        return honor_type(first, (concatenate([d[i] for d in data], dim=dim) for i in range(len(first))))
+    if isinstance(first, Mapping):
+        return type(first)({k: concatenate([d[k] for d in data], dim=dim) for k in first.keys()})
+    if not is_tensor(first):
+        raise TypeError(f"Can only concatenate tensors but got {type(first)}")
+    if isinstance(first, np.ndarray):
+        return np.concatenate([_to_numpy(d) for d in data], axis=dim)
+    return jnp.concatenate(data, axis=dim)
+
+
+# ---------------------------------------------------------------- debug checks
+def _shape_signature(data):
+    return [
+        (list(leaf.shape) if hasattr(leaf, "shape") else None)
+        for leaf in jax.tree_util.tree_leaves(data)
+        if is_tensor(leaf)
+    ]
+
+
+def verify_operation(function):
+    """Debug-mode cross-process shape verification (reference ``operations.py:361-402``).
+
+    With ``ACCELERATE_DEBUG_MODE=1`` every collective first gathers leaf shapes from
+    all processes and raises :class:`DistributedOperationException` on mismatch —
+    *before* the real op can deadlock the pod.
+    """
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        state = PartialState()
+        if not state.debug or state.num_processes == 1:
+            return function(*args, **kwargs)
+        operation = f"{function.__module__}.{function.__name__}"
+        tensor = kwargs.get("tensor", args[0] if args else None)
+        shapes = _shape_signature(tensor)
+        all_shapes = gather_object([shapes])
+        if not all(s == all_shapes[0] for s in all_shapes):
+            raise DistributedOperationException(
+                f"Cannot apply desired operation due to shape mismatches. All shapes across devices must be "
+                f"valid.\n\nOperation: `{operation}`\nInput shapes:\n"
+                + "\n".join(f"  - Process {i}: {s}" for i, s in enumerate(all_shapes))
+            )
+        return function(*args, **kwargs)
+
+    return wrapper
+
+
+def chained_operation(function):
+    """Re-raise DistributedOperationException with context (reference ``operations.py:404-421``)."""
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        try:
+            return function(*args, **kwargs)
+        except DistributedOperationException as e:
+            operation = f"{function.__module__}.{function.__name__}"
+            raise DistributedOperationException(
+                f"Error found while calling `{operation}`. Please see the earlier error for more details."
+            ) from e
+
+    return wrapper
+
+
+# ----------------------------------------------------------------- collectives
+def _gather_one(x):
+    """Materialize the full value of one tensor on every process."""
+    if isinstance(x, jax.Array):
+        if x.is_fully_addressable:
+            return np.asarray(jax.device_get(x))
+        return np.asarray(multihost_utils.process_allgather(x))
+    x = _to_numpy(x)
+    state = PartialState()
+    if state.num_processes == 1:
+        return x
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+@verify_operation
+def gather(tensor):
+    """Gather the global value of every tensor leaf on all processes.
+
+    Reference ``gather`` (``operations.py:425-441``): per-rank ``[b,...]`` →
+    ``[world*b,...]`` everywhere.  Here a sharded global array materializes in full;
+    a host-local numpy value is all-gathered across processes (concatenated on dim 0).
+    """
+    return recursively_apply(_gather_one, tensor)
+
+
+def gather_object(object: Any) -> List[Any]:
+    """Gather a picklable object from each process into a list (reference ``:444-467``)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return list(object) if isinstance(object, list) else [object]
+    payload = pickle.dumps(object)
+    data = np.frombuffer(payload, dtype=np.uint8)
+    local_size = np.array([data.size], dtype=np.int64)
+    all_sizes = multihost_utils.process_allgather(local_size, tiled=True)
+    max_size = int(all_sizes.max())
+    padded = np.zeros(max_size, dtype=np.uint8)
+    padded[: data.size] = data
+    gathered = multihost_utils.process_allgather(padded[None], tiled=True)
+    out = []
+    for i in range(state.num_processes):
+        obj = pickle.loads(gathered[i, : int(all_sizes[i])].tobytes())
+        if isinstance(object, list):
+            out.extend(obj)
+        else:
+            out.append(obj)
+    return out
+
+
+def _broadcast_one(x, from_process: int = 0):
+    state = PartialState()
+    if state.num_processes == 1:
+        return x
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(_to_numpy(x), is_source=state.process_index == from_process)
+    )
+
+
+@verify_operation
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast tensor leaves from one process to all (reference ``operations.py:470-483``)."""
+    return recursively_apply(functools.partial(_broadcast_one, from_process=from_process), tensor)
+
+
+def broadcast_object_list(object_list: List[Any], from_process: int = 0) -> List[Any]:
+    """In-place broadcast of a list of picklable objects (reference ``:486-499``)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return object_list
+    payload = pickle.dumps(list(object_list))
+    data = np.frombuffer(payload, dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(
+        np.array([data.size], dtype=np.int64), is_source=state.process_index == from_process
+    )
+    buf = np.zeros(int(size[0]), dtype=np.uint8)
+    if state.process_index == from_process:
+        buf[:] = data
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=state.process_index == from_process)
+    received = pickle.loads(np.asarray(buf).tobytes())
+    object_list[:] = received
+    return object_list
+
+
+def _num_shards_of(x) -> int:
+    if isinstance(x, jax.Array) and x.sharding is not None:
+        try:
+            mesh = x.sharding.mesh  # NamedSharding
+            return mesh_lib.num_data_shards(mesh)
+        except AttributeError:
+            return 1
+    return 1
+
+
+@verify_operation
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Sum/mean tensor leaves across workers (reference ``operations.py:727-765``).
+
+    For a *global* array sharded on dim 0 over the data axes (the SPMD analog of "a
+    tensor per rank"), the shard dimension is folded: ``[world*b, ...] -> [b, ...]``.
+    Replicated arrays are returned as-is (already reduced by XLA inside the step).
+    For host-local values, reduces across processes.
+    """
+
+    def _reduce_one(x):
+        state = PartialState()
+        if isinstance(x, jax.Array):
+            n = _num_shards_of(x)
+            full = _gather_one(x)
+            if n > 1 and full.shape and full.shape[0] % n == 0:
+                stacked = full.reshape((n, full.shape[0] // n) + full.shape[1:])
+                out = stacked.sum(axis=0) * scale
+                if reduction == "mean":
+                    out = out / n
+                return out
+            return full * scale if reduction == "sum" else full
+        x = _to_numpy(x)
+        if state.num_processes == 1:
+            return x * scale if reduction == "sum" else x
+        stacked = multihost_utils.process_allgather(x[None], tiled=True)
+        out = stacked.sum(axis=0) * scale
+        if reduction == "mean":
+            out = out / state.num_processes
+        return out
+
+    return recursively_apply(_reduce_one, tensor)
+
+
+@chained_operation
+@verify_operation
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad tensor leaves to the max size across processes (reference ``operations.py:623-663``).
+
+    Needed before ``gather`` when per-process batches are ragged (last batch of an
+    epoch without ``even_batches``).
+    """
+    state = PartialState()
+
+    def _pad_one(x):
+        x = _to_numpy(x)
+        if x.ndim == 0:
+            return x
+        sizes = gather_object([int(x.shape[dim])]) if state.num_processes > 1 else [x.shape[dim]]
+        max_size = max(sizes)
+        if max_size == x.shape[dim]:
+            return x
+        pad_width = [(0, 0)] * x.ndim
+        if pad_first:
+            pad_width[dim] = (max_size - x.shape[dim], 0)
+        else:
+            pad_width[dim] = (0, max_size - x.shape[dim])
+        return np.pad(x, pad_width, constant_values=pad_index)
+
+    return recursively_apply(_pad_one, tensor)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad dim0 so it divides ``num_processes`` (reference ``operations.py:666-724``)."""
+
+    def _pad_one(x):
+        x = _to_numpy(x)
+        remainder = x.shape[dim] % num_processes
+        if remainder == 0:
+            return x
+        pad_n = num_processes - remainder
+        idx = [slice(None)] * x.ndim
+        idx[dim] = slice(x.shape[dim] - 1, x.shape[dim])
+        last = x[tuple(idx)]
+        reps = [1] * x.ndim
+        reps[dim] = pad_n
+        return np.concatenate([x, np.tile(last, reps)], axis=dim)
+
+    return recursively_apply(_pad_one, tensor)
+
+
+# --------------------------------------------------------------- dtype casting
+def convert_to_fp32(tensor):
+    """Upcast float16/bfloat16 leaves to float32 (reference ``operations.py:768-789``)."""
+
+    def _convert(t):
+        return t.astype(jnp.float32) if hasattr(t, "astype") else t
+
+    def _is_half(t):
+        return is_tensor(t) and getattr(t, "dtype", None) in (jnp.float16, jnp.bfloat16, np.float16)
+
+    return recursively_apply(_convert, tensor, test_type=_is_half)
+
+
+class ConvertOutputsToFp32:
+    """Callable wrapper upcasting a function's outputs (reference ``operations.py:792-822``).
+
+    Picklable (unlike a closure), mirroring the reference's class-based design.
+    """
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        functools.update_wrapper(self, model_forward)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+
+def convert_outputs_to_fp32(model_forward):
+    return ConvertOutputsToFp32(model_forward)

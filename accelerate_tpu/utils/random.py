@@ -1,0 +1,83 @@
+"""Seeding & cross-process RNG synchronization.
+
+TPU-native analog of reference ``src/accelerate/utils/random.py`` (124 LoC).  JAX's
+explicit keys make most of the reference's state-broadcast machinery unnecessary —
+a key is just data — but the *host-side* RNGs (python/numpy, used by samplers and
+user code) still need seeding and cross-process sync.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Union
+
+import jax
+import numpy as np
+
+from .dataclasses import RNGType
+
+
+def PartialState():
+    """Lazy accessor (avoids a circular import with ``accelerate_tpu.state``)."""
+    from ..state import PartialState as _PartialState
+
+    return _PartialState()
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> int:
+    """Seed python/numpy (+ torch when present) and return the JAX root seed.
+
+    Mirrors reference ``set_seed`` (``utils/random.py:31-63``); ``device_specific``
+    offsets by process index (reference offsets by rank).
+    """
+    if device_specific:
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    try:
+        import torch
+
+        torch.manual_seed(seed)
+    except ImportError:
+        pass
+    return seed
+
+
+def make_rng_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
+    """Align one RNG across processes by broadcasting process 0's state.
+
+    Reference ``synchronize_rng_state`` (``utils/random.py:66-115``) broadcasts torch
+    RNG state tensors; here we broadcast a seed derived on process 0 and re-seed,
+    which gives the same guarantee (identical sampler order everywhere).
+    """
+    state = PartialState()
+    if state.num_processes <= 1:
+        return
+    from .operations import broadcast_object_list
+
+    if rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        broadcast_object_list(payload, from_process=0)
+        random.setstate(payload[0])
+    elif rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        broadcast_object_list(payload, from_process=0)
+        np.random.set_state(payload[0])
+    elif rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.state_dict() if hasattr(generator, "state_dict") else None]
+        broadcast_object_list(payload, from_process=0)
+        if payload[0] is not None and hasattr(generator, "load_state_dict"):
+            generator.load_state_dict(payload[0])
+    elif rng_type == RNGType.JAX:
+        payload = [np.random.randint(0, 2**31 - 1)]
+        broadcast_object_list(payload, from_process=0)
+        return jax.random.PRNGKey(payload[0])
+
+
+def synchronize_rng_states(rng_types: List[Union[str, RNGType]], generator=None):
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type), generator=generator)

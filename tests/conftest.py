@@ -1,0 +1,47 @@
+"""Test harness: force an 8-device CPU mesh (the reference's debug_launcher analog).
+
+Reference tests exercise "distributed" logic without a cluster via multi-process
+gloo (`launchers.py:263-296`); here the analog is XLA's forced host-platform device
+count — 8 virtual CPU devices in one process, over which real meshes/shardings/
+collectives run (SURVEY.md §4 lesson).
+
+Env vars must be set before JAX initializes a backend, hence at conftest import.
+``PALLAS_AXON_POOL_IPS`` is cleared so the axon TPU sitecustomize hook does not
+pin the platform in test subprocesses.
+"""
+
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU sitecustomize hook may have pinned jax_platforms before this
+# conftest ran; override it (the backend itself is not initialized yet).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_singleton_state():
+    """Reset Borg singletons between tests (reference ``AccelerateTestCase``,
+    ``test_utils/testing.py:429-441``)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    GradientState._reset_state()
+    AcceleratorState._reset_state(reset_partial_state=True)
+
+
+@pytest.fixture()
+def mesh8():
+    import jax
+
+    from accelerate_tpu.parallel.mesh import build_mesh
+
+    return build_mesh({"dp": 2, "fsdp": 4}, devices=jax.devices())

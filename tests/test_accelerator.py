@@ -1,0 +1,249 @@
+"""Accelerator end-to-end tests (reference: tests/test_accelerator.py,
+test_grad_sync.py semantics, test_script.py training_check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import (
+    Accelerator,
+    AcceleratedOptimizer,
+    AcceleratedScheduler,
+    FullyShardedDataParallelPlugin,
+    SimpleDataLoader,
+    TrainState,
+    ZeroPlugin,
+)
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def make_regression_data(n=64, seed=0):
+    """RegressionDataset analog (reference test_utils/training.py:22-42): y = 2x + 3 + noise."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    y = 2.0 * x + 3.0 + 0.01 * rng.normal(size=(n, 1)).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def regression_loss(params, batch):
+    pred = batch["x"] * params["a"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_state(acc, accum=None, lr=0.5):
+    params = {"a": jnp.zeros((1,)), "b": jnp.zeros((1,))}
+    return acc.create_train_state(params=params, tx=optax.sgd(lr))
+
+
+class TestPrepare:
+    def test_prepare_dataloader(self):
+        acc = Accelerator()
+        dl = acc.prepare(SimpleDataLoader(make_regression_data(), batch_size=8))
+        assert isinstance(dl, DataLoaderShard)
+
+    def test_prepare_optimizer(self):
+        acc = Accelerator()
+        opt = acc.prepare(optax.adam(1e-3))
+        assert isinstance(opt, AcceleratedOptimizer)
+
+    def test_prepare_schedule(self):
+        acc = Accelerator()
+        sched = acc.prepare(optax.linear_schedule(1.0, 0.0, 100))
+        assert isinstance(sched, AcceleratedScheduler)
+
+    def test_prepare_mixed_returns_order(self):
+        acc = Accelerator()
+        dl, opt = acc.prepare(SimpleDataLoader(make_regression_data(), batch_size=8), optax.adam(1e-3))
+        assert isinstance(dl, DataLoaderShard)
+        assert isinstance(opt, AcceleratedOptimizer)
+
+    def test_prepare_train_state_shards(self):
+        acc = Accelerator(fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=8))
+        state = TrainState.create(params={"w": jnp.ones((8, 8))}, tx=optax.sgd(0.1))
+        state = acc.prepare(state)
+        assert "fsdp" in str(state.params["w"].sharding.spec)
+
+
+class TestTraining:
+    def test_regression_converges(self):
+        acc = Accelerator()
+        state = make_state(acc)
+        dl = acc.prepare(SimpleDataLoader(make_regression_data(), batch_size=8, shuffle=True))
+        step = acc.compile_train_step(regression_loss)
+        for _ in range(3):
+            for batch in dl:
+                state, metrics = step(state, batch)
+        assert float(metrics["loss"]) < 0.05
+        np.testing.assert_allclose(np.asarray(state.params["a"]), [2.0], atol=0.2)
+        np.testing.assert_allclose(np.asarray(state.params["b"]), [3.0], atol=0.2)
+
+    def test_distributed_matches_single_device(self):
+        """Training on the 8-device mesh must match single-device math
+        (reference training_check, test_script.py:420)."""
+        results = {}
+        for mesh in ({"dp": 1}, {"dp": 8}):
+            AcceleratorState._reset_state(reset_partial_state=True)
+            GradientState._reset_state()
+            acc = Accelerator(mesh=mesh)
+            state = make_state(acc)
+            dl = acc.prepare(SimpleDataLoader(make_regression_data(), batch_size=16))
+            step = acc.compile_train_step(regression_loss)
+            for batch in dl:
+                state, _ = step(state, batch)
+            results[str(mesh)] = np.asarray(jax.device_get(state.params["a"]))
+        np.testing.assert_allclose(results["{'dp': 1}"], results["{'dp': 8}"], rtol=1e-5)
+
+    def test_gradient_accumulation_matches_full_batch(self):
+        """Two accumulated half-batches == one full batch (reference test_sync.py)."""
+        data = make_regression_data(n=32)
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        acc_full = Accelerator()
+        state_full = make_state(acc_full)
+        step_full = acc_full.compile_train_step(regression_loss)
+        dl_full = acc_full.prepare(SimpleDataLoader(data, batch_size=32))
+        for batch in dl_full:
+            state_full, _ = step_full(state_full, batch)
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        acc_acc = Accelerator(gradient_accumulation_steps=2)
+        state_acc = make_state(acc_acc)
+        step_acc = acc_acc.compile_train_step(regression_loss)
+        dl_half = acc_acc.prepare(SimpleDataLoader(data, batch_size=16))
+        for batch in dl_half:
+            state_acc, m = step_acc(state_acc, batch)
+
+        assert int(state_full.step) == 1
+        assert int(state_acc.step) == 1
+        np.testing.assert_allclose(
+            np.asarray(state_full.params["a"]), np.asarray(state_acc.params["a"]), rtol=1e-5
+        )
+
+    def test_end_of_dataloader_forces_sync(self):
+        """3 batches with accum=2: last batch must still apply (reference
+        GradientState.sync_with_dataloader semantics)."""
+        acc = Accelerator(gradient_accumulation_steps=2)
+        state = make_state(acc)
+        dl = acc.prepare(SimpleDataLoader(make_regression_data(n=24), batch_size=8))
+        step = acc.compile_train_step(regression_loss)
+        applied = []
+        for batch in dl:
+            state, m = step(state, batch)
+            applied.append(bool(m["applied"]))
+        assert applied == [False, True, True]
+        assert int(state.step) == 2
+
+    def test_bf16_policy_computes_in_bf16(self):
+        acc = Accelerator(mixed_precision="bf16")
+        captured = {}
+
+        def loss_fn(params, batch):
+            captured["dtype"] = params["a"].dtype
+            return jnp.mean((batch["x"] * params["a"] - batch["y"]) ** 2)
+
+        state = make_state(acc)
+        step = acc.compile_train_step(loss_fn)
+        batch = {"x": np.ones((8, 1), np.float32), "y": np.ones((8, 1), np.float32)}
+        state, _ = step(state, batch)
+        assert captured["dtype"] == jnp.bfloat16
+        assert state.params["a"].dtype == jnp.float32  # master weights stay fp32
+
+    def test_fp16_overflow_skips_step(self):
+        acc = Accelerator(mixed_precision="fp16")
+        state = make_state(acc)
+        assert state.loss_scale is not None
+
+        def inf_loss(params, batch):
+            return jnp.sum(params["a"]) * jnp.float32(1e38) * jnp.sum(batch["x"])
+
+        step = acc.compile_train_step(inf_loss)
+        batch = {"x": np.full((8, 1), 1e6, np.float32)}
+        old_scale = float(state.loss_scale.scale)
+        state, m = step(state, batch)
+        assert bool(m["overflow"])
+        assert int(state.step) == 0  # skipped
+        assert float(state.loss_scale.scale) < old_scale  # backoff
+
+    def test_imperative_mirror(self):
+        acc = Accelerator(gradient_accumulation_steps=2)
+        state = make_state(acc)
+        dl = acc.prepare(SimpleDataLoader(make_regression_data(n=32), batch_size=8))
+        steps_applied = 0
+        for batch in dl:
+            with acc.accumulate():
+                grads, m = acc.compute_gradients(regression_loss, state, batch)
+                state = acc.apply_gradients(state, grads)
+                if acc.sync_gradients:
+                    steps_applied += 1
+        assert steps_applied == 2
+        assert int(state.step) == 2
+
+    def test_backward_raises_with_guidance(self):
+        acc = Accelerator()
+        with pytest.raises(RuntimeError, match="compile_train_step"):
+            acc.backward(None)
+
+
+class TestCollectiveFacade:
+    def test_gather_for_metrics_truncates_remainder(self):
+        acc = Accelerator()
+        data = make_regression_data(n=14)
+        dl = acc.prepare(SimpleDataLoader(data, batch_size=4))
+        seen = 0
+        for batch in dl:
+            preds = batch["x"]  # pretend predictions
+            gathered = acc.gather_for_metrics(preds)
+            seen += np.asarray(gathered).shape[0]
+        assert seen == 14  # duplicates dropped at epoch end
+
+    def test_clip_grad_norm(self):
+        acc = Accelerator()
+        grads = {"w": jnp.full((4,), 10.0)}
+        clipped, norm = acc.clip_grad_norm_(grads, max_norm=1.0)
+        assert float(norm) == 20.0
+        assert np.allclose(np.asarray(optax.global_norm(clipped)), 1.0, atol=1e-4)
+
+    def test_clip_grad_value(self):
+        acc = Accelerator()
+        grads = {"w": jnp.array([-5.0, 5.0])}
+        clipped = acc.clip_grad_value_(grads, 1.0)
+        np.testing.assert_allclose(np.asarray(clipped["w"]), [-1.0, 1.0])
+
+    def test_set_check_trigger(self):
+        acc = Accelerator()
+        assert not acc.check_trigger()
+        acc.set_trigger()
+        assert acc.check_trigger()
+        assert not acc.check_trigger()
+
+    def test_get_state_dict_returns_host_numpy(self):
+        acc = Accelerator()
+        state = make_state(acc)
+        sd = acc.get_state_dict(state)
+        assert isinstance(sd["a"], np.ndarray)
+
+
+class TestZeroPlugin:
+    def test_zero3_maps_to_full_shard(self):
+        plugin = ZeroPlugin(zero_stage=3)
+        fsdp = plugin.to_fsdp_plugin()
+        assert fsdp.shards_params
+        assert fsdp.min_weight_size == 0
+
+    def test_zero2_shards_opt_only(self):
+        plugin = ZeroPlugin(zero_stage=2)
+        fsdp = plugin.to_fsdp_plugin()
+        assert not fsdp.shards_params
+        assert fsdp.shards_opt_state
+
+    def test_accelerator_with_zero(self):
+        acc = Accelerator(deepspeed_plugin=ZeroPlugin(zero_stage=3))
+        state = acc.create_train_state(
+            params={"w": jnp.ones((16, 16))}, tx=optax.adamw(1e-3)
+        )
+        assert "fsdp" in str(state.params["w"].sharding.spec)

@@ -1,0 +1,204 @@
+"""Sharding index-math tests (reference: tests/test_data_loader.py, 398 LoC of
+``BatchSamplerShard`` math checked per simulated process_index without any
+distributed launch — SURVEY §4 tier 1)."""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.data_loader import (
+    BatchSampler,
+    BatchSamplerShard,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SimpleDataLoader,
+    SkipBatchSampler,
+    default_collate,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import GradientState
+
+
+def shards_for(dataset_len, batch_size, n, split_batches=False, even_batches=True, drop_last=False):
+    inner = BatchSampler(range(dataset_len), batch_size, drop_last)
+    return [
+        list(
+            BatchSamplerShard(
+                inner, num_processes=n, process_index=i, split_batches=split_batches, even_batches=even_batches
+            )
+        )
+        for i in range(n)
+    ]
+
+
+class TestBatchSamplerShard:
+    def test_divisible_no_split(self):
+        shards = shards_for(24, 4, 2)
+        assert shards[0] == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 17, 18, 19]]
+        assert shards[1] == [[4, 5, 6, 7], [12, 13, 14, 15], [20, 21, 22, 23]]
+
+    def test_uneven_tail_cycles_from_start(self):
+        # 22 elements: the final short batch is completed by cycling the epoch's
+        # index stream (reference docstring behavior).
+        shards = shards_for(22, 4, 2)
+        assert shards[0] == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 17, 18, 19]]
+        assert shards[1] == [[4, 5, 6, 7], [12, 13, 14, 15], [20, 21, 0, 1]]
+
+    def test_missing_batch_is_built_from_cycle(self):
+        # 17 elements -> 5 batches; shard 1's third batch is built from cycled indices.
+        shards = shards_for(17, 4, 2)
+        assert shards[0] == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 0, 1, 2]]
+        assert shards[1] == [[4, 5, 6, 7], [12, 13, 14, 15], [3, 4, 5, 6]]
+
+    def test_not_even(self):
+        shards = shards_for(22, 4, 2, even_batches=False)
+        assert shards[0] == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 17, 18, 19]]
+        assert shards[1] == [[4, 5, 6, 7], [12, 13, 14, 15], [20, 21]]
+
+    def test_drop_last(self):
+        shards = shards_for(22, 4, 2, drop_last=True)
+        # 5 full batches -> 2 complete groups, the 5th batch is dropped
+        assert shards[0] == [[0, 1, 2, 3], [8, 9, 10, 11]]
+        assert shards[1] == [[4, 5, 6, 7], [12, 13, 14, 15]]
+
+    def test_split_batches(self):
+        shards = shards_for(24, 4, 2, split_batches=True)
+        assert shards[0] == [[0, 1], [4, 5], [8, 9], [12, 13], [16, 17], [20, 21]]
+        assert shards[1] == [[2, 3], [6, 7], [10, 11], [14, 15], [18, 19], [22, 23]]
+
+    def test_split_batches_uneven_even(self):
+        shards = shards_for(22, 4, 2, split_batches=True)
+        # final batch [20,21] completed by cycling itself to size 4 then split
+        assert shards[0][-1] == [20, 21]
+        assert shards[1][-1] == [20, 21]
+
+    def test_split_batches_uneven_not_even(self):
+        shards = shards_for(22, 4, 2, split_batches=True, even_batches=False)
+        assert shards[0][-1] == [20]
+        assert shards[1][-1] == [21]
+
+    def test_split_batches_requires_divisible(self):
+        inner = BatchSampler(range(10), 3, False)
+        with pytest.raises(ValueError):
+            BatchSamplerShard(inner, num_processes=2, process_index=0, split_batches=True)
+
+    @pytest.mark.parametrize("dataset_len", [7, 16, 23, 40, 41])
+    @pytest.mark.parametrize("n", [1, 2, 4])
+    def test_even_invariants(self, dataset_len, n):
+        shards = shards_for(dataset_len, 4, n)
+        lengths = {len(s) for s in shards}
+        assert len(lengths) == 1  # all processes see the same number of batches
+        for s in shards:
+            assert all(len(b) == 4 for b in s)  # all batches full
+        covered = set(itertools.chain.from_iterable(itertools.chain.from_iterable(shards)))
+        assert covered == set(range(dataset_len))  # full coverage
+
+    def test_len_matches_iteration(self):
+        for dataset_len, n, even in [(22, 2, True), (22, 2, False), (17, 4, True)]:
+            for i in range(n):
+                inner = BatchSampler(range(dataset_len), 4, False)
+                shard = BatchSamplerShard(inner, num_processes=n, process_index=i, even_batches=even)
+                assert len(shard) == len(list(shard))
+
+
+class TestIterableDatasetShard:
+    def test_even_split(self):
+        ds = IterableDatasetShard(range(16), batch_size=2, num_processes=2, process_index=0)
+        assert list(ds) == [0, 1, 4, 5, 8, 9, 12, 13]
+        ds1 = IterableDatasetShard(range(16), batch_size=2, num_processes=2, process_index=1)
+        assert list(ds1) == [2, 3, 6, 7, 10, 11, 14, 15]
+
+    def test_tail_padded_from_first_buffer(self):
+        ds = IterableDatasetShard(range(10), batch_size=2, num_processes=2, process_index=1)
+        out = list(ds)
+        assert out[:4] == [2, 3, 6, 7]
+        assert len(out) == 6  # padded tail slice
+
+    def test_drop_last(self):
+        ds = IterableDatasetShard(range(10), batch_size=2, num_processes=2, process_index=0, drop_last=True)
+        assert list(ds) == [0, 1, 4, 5]
+
+
+class TestSeedableRandomSampler:
+    def test_deterministic_per_epoch(self):
+        s = SeedableRandomSampler(10, seed=42)
+        first = list(s)
+        assert first == list(SeedableRandomSampler(10, seed=42))
+        s.set_epoch(1)
+        second = list(s)
+        assert first != second
+        assert sorted(second) == list(range(10))
+
+
+class TestDataLoaderShard:
+    def _loader(self, n=16, bs=4):
+        data = [{"x": np.full((3,), i, np.float32), "y": np.float32(i)} for i in range(n)]
+        return SimpleDataLoader(data, batch_size=bs)
+
+    def test_device_placement_and_shapes(self):
+        dl = prepare_data_loader(self._loader())
+        batches = list(dl)
+        assert len(batches) == 4
+        assert batches[0]["x"].shape == (4, 3)
+        import jax
+
+        assert isinstance(batches[0]["x"], jax.Array)
+
+    def test_end_of_dataloader_flag(self):
+        dl = prepare_data_loader(self._loader())
+        gs = GradientState()
+        seen = []
+        for _ in dl:
+            seen.append(gs.end_of_dataloader)
+        assert seen == [False, False, False, True]
+
+    def test_remainder(self):
+        dl = prepare_data_loader(self._loader(n=14, bs=4))
+        gs = GradientState()
+        for _ in dl:
+            pass
+        assert dl.remainder == 14 % dl.total_batch_size
+
+    def test_gradient_state_registration(self):
+        dl = prepare_data_loader(self._loader())
+        gs = GradientState()
+        assert not gs.in_dataloader
+        for _ in dl:
+            assert gs.in_dataloader
+        assert not gs.in_dataloader
+
+    def test_iteration_advances_epoch(self):
+        dl = prepare_data_loader(self._loader())
+        list(dl)
+        assert dl.iteration == 1
+
+    def test_total_batch_size_single_process(self):
+        dl = prepare_data_loader(self._loader(bs=4))
+        assert dl.total_batch_size == 4
+
+
+class TestSkipBatches:
+    def test_skip_batch_sampler(self):
+        inner = BatchSampler(range(16), 4, False)
+        skipped = SkipBatchSampler(inner, skip_batches=2)
+        assert list(skipped) == [[8, 9, 10, 11], [12, 13, 14, 15]]
+        assert len(skipped) == 2
+
+    def test_skip_first_batches_on_shard(self):
+        data = [{"x": np.full((2,), i, np.float32)} for i in range(16)]
+        dl = prepare_data_loader(SimpleDataLoader(data, batch_size=4))
+        resumed = skip_first_batches(dl, 2)
+        batches = list(resumed)
+        assert len(batches) == 2
+        np.testing.assert_array_equal(np.asarray(batches[0]["x"])[:, 0], [8, 9, 10, 11])
+
+
+def test_default_collate_nested():
+    items = [{"a": np.ones(2), "b": (1, 2)}, {"a": np.zeros(2), "b": (3, 4)}]
+    out = default_collate(items)
+    assert out["a"].shape == (2, 2)
+    assert out["b"][0].shape == (2,)

@@ -1,0 +1,142 @@
+"""Tests for pytree operations & host-level collectives (reference: tests/test_utils.py,
+tests/test_ops.py)."""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.parallel.mesh import build_mesh, data_sharding
+from accelerate_tpu.utils import operations as ops
+
+
+Point = collections.namedtuple("Point", ["x", "y"])
+
+
+def test_recursively_apply_containers():
+    data = {"a": np.ones(2), "b": [np.zeros(3), (np.ones(1),)], "c": "keep", "p": Point(np.ones(2), 5)}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert out["c"] == "keep"
+    assert isinstance(out["p"], Point)
+    np.testing.assert_allclose(out["a"], 2 * np.ones(2))
+    np.testing.assert_allclose(out["b"][0], np.ones(3))
+    assert out["p"].y == 5
+
+
+def test_recursively_apply_error_on_other_type():
+    with pytest.raises(TypeError):
+        ops.recursively_apply(lambda t: t, {"a": "str"}, error_on_other_type=True)
+
+
+def test_honor_type_namedtuple():
+    p = Point(1, 2)
+    q = ops.honor_type(p, iter([3, 4]))
+    assert isinstance(q, Point) and q.x == 3 and q.y == 4
+
+
+def test_send_to_device_replicates():
+    batch = {"x": np.ones((8, 4), np.float32)}
+    out = ops.send_to_device(batch, jax.devices()[0])
+    assert isinstance(out["x"], jax.Array)
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(4), "meta": np.ones(2)}
+    out = ops.send_to_device(batch, jax.devices()[0], skip_keys=["meta"])
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_gather_sharded_array():
+    mesh = build_mesh({"dp": 8})
+    x = jax.device_put(np.arange(16, dtype=np.float32).reshape(16, 1), data_sharding(mesh))
+    full = ops.gather(x)
+    np.testing.assert_array_equal(full, np.arange(16).reshape(16, 1))
+
+
+def test_gather_pytree():
+    mesh = build_mesh({"dp": 8})
+    tree = {"a": jax.device_put(np.arange(8, dtype=np.float32), data_sharding(mesh)), "b": "keep"}
+    out = ops.gather(tree)
+    np.testing.assert_array_equal(out["a"], np.arange(8))
+    assert out["b"] == "keep"
+
+
+def test_gather_object_single_process():
+    assert ops.gather_object([1, 2]) == [1, 2]
+    assert ops.gather_object({"k": 1}) == [{"k": 1}]
+
+
+def test_broadcast_single_process_identity():
+    x = np.arange(4)
+    np.testing.assert_array_equal(ops.broadcast(x), x)
+
+
+def test_reduce_folds_shard_dim():
+    mesh = build_mesh({"dp": 4})
+    # global [4*2] array: shard i holds [2] values equal to i
+    vals = np.repeat(np.arange(4, dtype=np.float32), 2)
+    x = jax.device_put(vals, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp")))
+    summed = ops.reduce(x, reduction="sum")
+    np.testing.assert_allclose(summed, np.array([0 + 1 + 2 + 3] * 2, dtype=np.float32))
+    mean = ops.reduce(x, reduction="mean")
+    np.testing.assert_allclose(mean, np.array([1.5, 1.5], dtype=np.float32))
+
+
+def test_pad_across_processes_noop_single():
+    x = np.ones((3, 2))
+    out = ops.pad_across_processes(x)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_pad_input_tensors():
+    x = np.arange(5)
+    out = ops.pad_input_tensors(x, batch_size=5, num_processes=4)
+    assert out.shape[0] == 8
+    np.testing.assert_array_equal(out[5:], np.array([4, 4, 4]))
+
+
+def test_concatenate_pytrees():
+    a = {"x": np.ones((2, 3))}
+    b = {"x": np.zeros((3, 3))}
+    out = ops.concatenate([a, b])
+    assert out["x"].shape == (5, 3)
+
+
+def test_find_batch_size():
+    assert ops.find_batch_size({"a": np.ones((7, 2))}) == 7
+    with pytest.raises(ValueError):
+        ops.find_batch_size({})
+
+
+def test_listify():
+    out = ops.listify({"a": jnp.arange(3)})
+    assert out["a"] == [0, 1, 2]
+
+
+def test_convert_to_fp32():
+    data = {"h": jnp.ones(2, dtype=jnp.bfloat16), "f": jnp.ones(2, dtype=jnp.float32), "i": jnp.ones(2, dtype=jnp.int32)}
+    out = ops.convert_to_fp32(data)
+    assert out["h"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32
+
+
+def test_convert_outputs_to_fp32_picklable():
+    import pickle
+
+    fn = ops.convert_outputs_to_fp32(_half_fn)
+    assert pickle.loads(pickle.dumps(fn)) is not None
+    out = fn()
+    assert out.dtype == jnp.float32
+
+
+def _half_fn():
+    return jnp.ones(2, dtype=jnp.bfloat16)
+
+
+def test_slice_tensors():
+    data = {"x": np.arange(10)}
+    out = ops.slice_tensors(data, slice(2, 4))
+    np.testing.assert_array_equal(out["x"], np.array([2, 3]))

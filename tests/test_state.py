@@ -1,0 +1,85 @@
+"""Tests for PartialState/AcceleratorState/GradientState (reference: tests/test_state_checkpointing.py
+setup parts + state behavior exercised throughout the reference suite)."""
+
+import jax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import DistributedType, MeshConfig
+
+
+def test_partial_state_topology():
+    state = PartialState()
+    assert state.num_devices == 8
+    assert state.num_processes == 1
+    assert state.process_index == 0
+    assert state.is_main_process
+    assert state.is_local_main_process
+    assert state.is_last_process
+    assert state.distributed_type == DistributedType.MULTI_CPU
+
+
+def test_partial_state_is_borg():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+
+
+def test_default_mesh_is_dp():
+    state = PartialState()
+    assert dict(state.mesh.shape) == {"dp": 8}
+
+
+def test_set_mesh_from_dict():
+    state = PartialState()
+    mesh = state.set_mesh({"dp": 2, "tp": 4})
+    assert dict(mesh.shape) == {"dp": 2, "tp": 4}
+
+
+def test_set_mesh_from_config():
+    state = PartialState()
+    mesh = state.set_mesh(MeshConfig(axes={"dp": -1, "tp": 2}))
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as inputs:
+        assert inputs == [1, 2, 3]
+
+
+def test_on_main_process_runs():
+    state = PartialState()
+    calls = []
+
+    @state.on_main_process
+    def fn():
+        calls.append(1)
+
+    fn()
+    assert calls == [1]
+
+
+def test_accelerator_state_mixed_precision_conflict():
+    AcceleratorState(mixed_precision="bf16")
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_accelerator_state_delegates_topology():
+    state = AcceleratorState()
+    assert state.num_devices == 8
+    assert state.is_main_process
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert not gs.in_dataloader
+    assert gs.remainder == -1
+    assert gs.num_steps == 1
+
+
+def test_wait_for_everyone_single_process_noop():
+    PartialState().wait_for_everyone()
