@@ -190,7 +190,6 @@ class Accelerator:
         self._save_model_state_pre_hooks: Dict[Any, Callable] = {}
         self._load_model_state_pre_hooks: Dict[Any, Callable] = {}
         self._jit_cache: Dict[Any, Callable] = {}
-        self._state_shardings: Dict[int, Any] = {}
 
     # --------------------------------------------------------------- topology
     def _default_mesh(self):
@@ -442,7 +441,6 @@ class Accelerator:
         abstract = jax.eval_shape(init_fn, params)
         shardings = self._train_state_shardings(abstract)
         state = jax.jit(init_fn, out_shardings=shardings)(params)
-        self._state_shardings[id(state)] = shardings
         return state
 
     def _train_state_shardings(self, abstract_state):
@@ -465,7 +463,6 @@ class Accelerator:
         abstract = jax.eval_shape(lambda s: s, state)
         shardings = self._train_state_shardings(abstract)
         sharded = jax.jit(lambda s: s, out_shardings=shardings)(state)
-        self._state_shardings[id(sharded)] = shardings
         return sharded
 
     # ------------------------------------------------------------- step build
@@ -840,7 +837,6 @@ class Accelerator:
     def free_memory(self, *objects):
         """Release compiled/jitted caches and live buffers (reference ``accelerator.py:3158``)."""
         self._jit_cache.clear()
-        self._state_shardings.clear()
         self._models.clear()
         self._optimizers.clear()
         self._schedulers.clear()

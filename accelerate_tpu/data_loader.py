@@ -497,10 +497,12 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         prefetch_size: int = 2,
         mesh=None,
         slice_fn=None,
+        even_batches: bool = True,
         **kwargs,
     ):
         self.base_dataloader = base_dataloader
         self.split_batches = split_batches
+        self.even_batches = even_batches
         self.skip_batches = skip_batches
         self.state = PartialState()
         self.gradient_state = GradientState()
@@ -568,15 +570,25 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         return batch
 
     def _local_slice(self, batch):
-        """Each process keeps its contiguous chunk of the broadcast global batch."""
+        """Each process keeps its contiguous chunk of the broadcast global batch.
+
+        A ragged tail batch is padded by repeating the final sample when
+        ``even_batches`` (reference ``_fetch_batches`` tail handling); the
+        duplicates are dropped later by ``gather_for_metrics`` via ``remainder``.
+        """
         if self.state.num_processes == 1:
             return batch
         observed = find_batch_size(batch)
         if observed % self.state.num_processes != 0:
-            raise ValueError(
-                f"Dispatched global batch of {observed} does not divide {self.state.num_processes} "
-                "processes; use even_batches or a divisible batch size."
-            )
+            if not self.even_batches:
+                raise ValueError(
+                    f"Dispatched global batch of {observed} does not divide "
+                    f"{self.state.num_processes} processes and even_batches is off."
+                )
+            from .utils.operations import pad_input_tensors
+
+            batch = pad_input_tensors(batch, observed, self.state.num_processes)
+            observed = find_batch_size(batch)
         chunk = observed // self.state.num_processes
         lo = self.state.process_index * chunk
         return self.slice_fn(batch, slice(lo, lo + chunk))
@@ -654,6 +666,9 @@ class SimpleDataLoader:
     def set_epoch(self, epoch: int):
         if hasattr(self.batch_sampler, "set_epoch"):
             self.batch_sampler.set_epoch(epoch)
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
 
     def __len__(self):
         return len(self.batch_sampler)
@@ -718,6 +733,7 @@ def prepare_data_loader(
             prefetch_size=prefetch_size,
             mesh=mesh,
             slice_fn=slice_fn_for_dispatch,
+            even_batches=even_batches,
         )
 
     synchronized_generator = None
@@ -854,6 +870,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             put_on_device=dataloader.placer.put_on_device,
             mesh=dataloader.placer._mesh,
             slice_fn=dataloader.slice_fn,
+            even_batches=dataloader.even_batches,
         )
     if isinstance(dataloader, DataLoaderShard):
         return DataLoaderShard(

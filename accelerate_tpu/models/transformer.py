@@ -1,0 +1,254 @@
+"""Flagship decoder-only transformer (Llama-family architecture) in flax linen.
+
+This is the model the framework's benchmarks and multi-chip dry-runs drive
+(BASELINE.md targets: Llama-2-7B FSDP on a pod; GPT-2-XL ZeRO-3).  Architecture:
+pre-norm RMSNorm, rotary position embeddings, grouped-query attention, SwiGLU MLP —
+the standard Llama-2/3 recipe, written TPU-first:
+
+  - static shapes everywhere; layers optionally rolled into ``nn.scan``
+    (compile-time win, and the substrate for pipeline parallelism);
+  - optional ``jax.checkpoint`` per layer (remat ≡ activation checkpointing,
+    the reference's ``FSDP_ACTIVATION_CHECKPOINTING``);
+  - attention via ``ops.attention`` (XLA fused / pallas flash / ring);
+  - tensor/sequence-parallel sharding is applied *outside* the model by
+    path-based rules (``parallel/tensor_parallel.py``) — the module itself is
+    placement-agnostic, per the design stance of SURVEY §7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False                # jax.checkpoint each layer
+    scan_layers: bool = False          # roll layers into lax.scan
+    attention_impl: str = "xla"        # "xla" | "pallas" | "ring"
+    dropout_rate: float = 0.0
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(**{**dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                             num_layers=32, num_heads=32, num_kv_heads=32), **kw})
+
+    @classmethod
+    def gpt2_xl_equiv(cls, **kw):
+        """GPT-2-XL-sized decoder (1.5B) for the ZeRO-3 parity target."""
+        return cls(**{**dict(vocab_size=50257, hidden_size=1600, intermediate_size=6400,
+                             num_layers=48, num_heads=25, num_kv_heads=25,
+                             max_seq_len=1024), **kw})
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-sized config (unit tests, dry-runs)."""
+        return cls(**{**dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                             num_layers=2, num_heads=4, num_kv_heads=2,
+                             max_seq_len=128), **kw})
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim of [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        normed = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        hd = cfg.resolved_head_dim
+        dense = functools_partial_dense(cfg)
+        q = dense("q_proj", cfg.num_heads * hd)(x)
+        k = dense("k_proj", cfg.num_kv_heads * hd)(x)
+        v = dense("v_proj", cfg.num_kv_heads * hd)(x)
+        b, s = x.shape[:2]
+        q = q.reshape(b, s, cfg.num_heads, hd)
+        k = k.reshape(b, s, cfg.num_kv_heads, hd)
+        v = v.reshape(b, s, cfg.num_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        out = dot_product_attention(
+            q, k, v, causal=True, implementation=cfg.attention_impl, segment_ids=segment_ids
+        )
+        out = out.reshape(b, s, cfg.num_heads * hd)
+        return dense("o_proj", cfg.hidden_size)(out)
+
+
+def functools_partial_dense(cfg: TransformerConfig):
+    def make(name: str, features: int):
+        return nn.Dense(
+            features,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
+
+    return make
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = functools_partial_dense(cfg)
+        gate = dense("gate_proj", cfg.intermediate_size)(x)
+        up = dense("up_proj", cfg.intermediate_size)(x)
+        return dense("down_proj", cfg.hidden_size)(nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x), positions
+        )
+        x = x + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x)
+        )
+        return x
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM.  ``__call__(input_ids [B,S]) -> logits [B,S,V]``."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
+            )
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+            name="embed_tokens",
+        )
+        x = embed(input_ids)
+
+        if cfg.scan_layers:
+            # Roll layers into one scanned module: params stack on axis 0,
+            # compile time is O(1) in depth, and stages slice cleanly for PP.
+            body = ScanBody
+            if cfg.remat:
+                body = nn.remat(ScanBody, prevent_cse=False)
+            ScanLayers = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                in_axes=(nn.broadcast,),
+            )
+            x, _ = ScanLayers(cfg, name="layers")(x, positions)
+        else:
+            layer_cls = DecoderLayer
+            if cfg.remat:
+                layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size,
+                use_bias=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(0.02),
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+class ScanBody(nn.Module):
+    """Scan-compatible layer body: carry = hidden states, broadcast = positions."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return DecoderLayer(self.config, name="layer")(x, positions), None
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100, z_loss: float = 0.0):
+    """Token-level CE with optional z-loss (stabilizes large-vocab training)."""
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(logz)
+    nll = jnp.where(mask, nll, 0.0)
+    return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def lm_loss_fn(model: Transformer):
+    """Standard next-token loss for ``Accelerator.compile_train_step``."""
+
+    def loss_fn(params, batch, rng=None):
+        logits = model.apply({"params": params}, batch["input_ids"])
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+        return cross_entropy_loss(logits, labels)
+
+    return loss_fn

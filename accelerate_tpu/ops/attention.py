@@ -1,0 +1,71 @@
+"""Attention dispatch: one entry point, multiple TPU implementations.
+
+The reference delegates fused attention to its CUDA backends (Megatron fused
+kernels, ``utils/megatron_lm.py``); here the implementations are:
+
+  - ``"xla"``: ``jax.nn.dot_product_attention`` — XLA's fused attention path
+    (flash-attention-style tiling on TPU via Mosaic when available).
+  - ``"pallas"``: hand-written flash attention kernel (``ops/flash_attention.py``).
+  - ``"ring"``: sequence-parallel ring attention over an ``sp`` mesh axis
+    (``parallel/ring_attention.py``) — net-new capability vs the reference
+    (SURVEY §5.7: long context is absent upstream).
+
+All take ``[batch, seq, heads, head_dim]`` (BSHD) tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.float32) -> jax.Array:
+    """Additive causal mask of shape [q_len, kv_len] (0 keep / -inf drop)."""
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(kv_len)[None, :]
+    offset = kv_len - q_len
+    return jnp.where(j <= i + offset, 0.0, jnp.finfo(dtype).min).astype(dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    implementation: str = "xla",
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """BSHD attention. GQA supported (k/v may have fewer heads than q)."""
+    if implementation == "pallas":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if implementation == "ring":
+        raise ValueError("ring attention must be called inside shard_map; use parallel.ring_attention")
+
+    # XLA path: grouped-query handled by repeating kv heads.
+    n_q_heads, n_kv_heads = q.shape[2], k.shape[2]
+    if n_kv_heads != n_q_heads:
+        rep = n_q_heads // n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    try:
+        return jax.nn.dot_product_attention(
+            q, k, v, is_causal=causal, scale=scale, implementation=None
+        )
+    except TypeError:  # older signature
+        return _reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _reference_attention(q, k, v, *, causal: bool, scale: Optional[float]):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        logits = logits + causal_mask(q.shape[1], k.shape[1], logits.dtype)[None, None]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -193,7 +193,13 @@ class PartialState:
         if self.num_processes == 1:
             yield inputs
             return
-        length = len(inputs)
+        if isinstance(inputs, dict):
+            lengths = {len(v) for v in inputs.values()}
+            if len(lengths) != 1:
+                raise ValueError("All values in a dict passed to split_between_processes must have equal length")
+            length = lengths.pop()
+        else:
+            length = len(inputs)
         split_sizes = [length // self.num_processes] * self.num_processes
         for i in range(length % self.num_processes):
             split_sizes[i] += 1
@@ -215,9 +221,6 @@ class PartialState:
             return chunk
 
         if isinstance(inputs, dict):
-            lengths = {len(v) for v in inputs.values()}
-            if len(lengths) != 1:
-                raise ValueError("All values in a dict passed to split_between_processes must have equal length")
             yield {k: _slice(v) for k, v in inputs.items()}
         else:
             yield _slice(inputs)

@@ -344,13 +344,23 @@ def broadcast_object_list(object_list: List[Any], from_process: int = 0) -> List
 
 
 def _num_shards_of(x) -> int:
-    if isinstance(x, jax.Array) and x.sharding is not None:
-        try:
-            mesh = x.sharding.mesh  # NamedSharding
-            return mesh_lib.num_data_shards(mesh)
-        except AttributeError:
-            return 1
-    return 1
+    """Number of shards of dim 0 over the data axes — 1 for replicated arrays."""
+    if not isinstance(x, jax.Array) or x.sharding is None:
+        return 1
+    try:
+        mesh = x.sharding.mesh
+        spec = x.sharding.spec
+    except AttributeError:
+        return 1
+    if not spec or spec[0] is None:
+        return 1
+    dim0_axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    data_axes = [a for a in dim0_axes if a in mesh_lib.DATA_AXES]
+    if not data_axes:
+        return 1
+    import math
+
+    return math.prod(mesh.shape[a] for a in data_axes)
 
 
 @verify_operation
@@ -374,7 +384,7 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
                 if reduction == "mean":
                     out = out / n
                 return out
-            return full * scale if reduction == "sum" else full
+            return full * scale
         x = _to_numpy(x)
         if state.num_processes == 1:
             return x * scale if reduction == "sum" else x

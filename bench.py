@@ -1,0 +1,100 @@
+"""Headline benchmark: BERT-base-class training throughput per chip.
+
+Mirrors the reference's primary target workload (BASELINE.json: BERT-base
+GLUE/MRPC via ``examples/nlp_example.py`` — seq 128 classification-scale
+training).  We train a BERT-base-sized (~110M param) transformer with the
+framework's compiled train step (bf16, grad clip, adamw) and report
+samples/sec/chip.
+
+``vs_baseline`` compares against an A100 80GB running the same-size model in
+fp16 with HF Accelerate+torch (~650 samples/s for BERT-base seq128 — the
+"≥ A100 step-time" bar from BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_BASELINE_SAMPLES_PER_SEC = 650.0
+
+BATCH = 64
+SEQ = 128
+WARMUP = 5
+STEPS = 20
+
+
+def main():
+    import optax
+
+    import accelerate_tpu as at
+    from accelerate_tpu.models.transformer import Transformer, TransformerConfig, lm_loss_fn
+
+    # BERT-base geometry (110M): hidden 768, 12 layers, 12 heads, vocab 30522.
+    cfg = TransformerConfig(
+        vocab_size=30522,
+        hidden_size=768,
+        intermediate_size=3072,
+        num_layers=12,
+        num_heads=12,
+        num_kv_heads=12,
+        max_seq_len=SEQ,
+    )
+    model = Transformer(cfg)
+
+    acc = at.Accelerator(mixed_precision="bf16")
+    n_chips = len(jax.devices())
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    state = acc.create_train_state(params=params, tx=optax.adamw(5e-5), seed=0)
+    step = acc.compile_train_step(lm_loss_fn(model), max_grad_norm=1.0)
+
+    batch = {"input_ids": ids}
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * STEPS / dt
+    per_chip = samples_per_sec / n_chips
+    # 6*N FLOPs per token (fwd+bwd) — standard transformer estimate.
+    tflops = 6 * n_params * SEQ * samples_per_sec / 1e12
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_train_samples_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
+                "detail": {
+                    "params": n_params,
+                    "batch": BATCH,
+                    "seq": SEQ,
+                    "chips": n_chips,
+                    "step_ms": round(1e3 * dt / STEPS, 2),
+                    "model_tflops_per_sec": round(tflops, 1),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
