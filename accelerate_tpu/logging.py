@@ -1,0 +1,57 @@
+"""Rank-aware logging.
+
+Port of reference ``src/accelerate/logging.py`` (125 LoC): a ``logging`` adapter
+that gates records on ``main_process_only`` / per-process emission and supports
+``in_order`` sequential printing across processes, plus ``warning_once``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Optional
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Reference ``MultiProcessAdapter`` (``logging.py:22-83``)."""
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        if self.isEnabledFor(level):
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            elif in_order:
+                from .state import PartialState
+
+                state = PartialState()
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kwargs = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kwargs)
+                    state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        """Emit a given warning only once (reference ``logging.py:74-83``)."""
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: Optional[str] = None) -> MultiProcessAdapter:
+    """Reference ``get_logger`` (``logging.py:85-125``); honors ``ACCELERATE_LOG_LEVEL``."""
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

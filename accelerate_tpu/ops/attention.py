@@ -44,9 +44,12 @@ def dot_product_attention(
     if implementation == "pallas":
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
     if implementation == "ring":
-        raise ValueError("ring attention must be called inside shard_map; use parallel.ring_attention")
+        raise ValueError(
+            "ring attention runs inside shard_map over the `sp` axis; call "
+            "accelerate_tpu.parallel.ring_attention.ring_attention instead"
+        )
 
     # XLA path: grouped-query handled by repeating kv heads.
     n_q_heads, n_kv_heads = q.shape[2], k.shape[2]
@@ -54,18 +57,24 @@ def dot_product_attention(
         rep = n_q_heads // n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    mask = None
+    if segment_ids is not None:
+        # packed sequences: tokens attend only within their own segment
+        mask = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None, :, :]
     try:
         return jax.nn.dot_product_attention(
-            q, k, v, is_causal=causal, scale=scale, implementation=None
+            q, k, v, mask=mask, is_causal=causal, scale=scale, implementation=None
         )
     except TypeError:  # older signature
-        return _reference_attention(q, k, v, causal=causal, scale=scale)
+        return _reference_attention(q, k, v, causal=causal, scale=scale, mask=mask)
 
 
-def _reference_attention(q, k, v, *, causal: bool, scale: Optional[float]):
+def _reference_attention(q, k, v, *, causal: bool, scale: Optional[float], mask=None):
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         logits = logits + causal_mask(q.shape[1], k.shape[1], logits.dtype)[None, None]
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -210,14 +210,16 @@ class PartialState:
             chunk = obj[start:end]
             if apply_padding and len(chunk) < split_sizes[0]:
                 pad_n = split_sizes[0] - len(chunk)
+                # pad from the *global* last element so even empty chunks pad
+                filler = obj[-1:]
                 if isinstance(chunk, np.ndarray):
-                    chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad_n, axis=0)])
+                    chunk = np.concatenate([chunk] + [np.asarray(filler)] * pad_n)
                 elif hasattr(chunk, "shape"):
                     import jax.numpy as jnp
 
-                    chunk = jnp.concatenate([chunk, jnp.repeat(chunk[-1:], pad_n, axis=0)])
+                    chunk = jnp.concatenate([chunk] + [jnp.asarray(filler)] * pad_n)
                 else:
-                    chunk = list(chunk) + [chunk[-1]] * pad_n
+                    chunk = list(chunk) + [obj[-1]] * pad_n
             return chunk
 
         if isinstance(inputs, dict):

@@ -1,0 +1,417 @@
+"""Experiment trackers.
+
+TPU-native port of reference ``src/accelerate/tracking.py`` (1023 LoC):
+``GeneralTracker`` ABC + the same tracker roster (TensorBoard, WandB, CometML,
+Aim, MLflow, ClearML, DVCLive — each gated on availability), ``filter_trackers``,
+and main-process-only execution.  One addition: :class:`JSONTracker`, a
+zero-dependency tracker writing ``metrics.jsonl`` (always available, used as the
+default in tests and examples).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Run the method only on the main process (reference ``tracking.py:67-83``)."""
+
+    @functools.wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Base tracker API (reference ``tracking.py:91-162``)."""
+
+    main_process_only = True
+    name: str = "general"
+    requires_logging_directory: bool = False
+
+    def __init__(self, _blank: bool = False):
+        pass
+
+    @property
+    def tracker(self):
+        raise NotImplementedError
+
+    def store_init_configuration(self, values: dict):
+        raise NotImplementedError
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        raise NotImplementedError
+
+    def finish(self):
+        pass
+
+
+class JSONTracker(GeneralTracker):
+    """Dependency-free tracker: appends one JSON object per ``log`` call to
+    ``<logging_dir>/<run_name>/metrics.jsonl`` (net-new vs the reference)."""
+
+    name = "json"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.run_dir = os.path.join(logging_dir or ".", run_name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.path = os.path.join(self.run_dir, "metrics.jsonl")
+        self._fh = open(self.path, "a")
+
+    @property
+    def tracker(self):
+        return self._fh
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(os.path.join(self.run_dir, "config.json"), "w") as f:
+            json.dump(values, f, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        record = {"_step": step, "_time": time.time(), **values}
+        self._fh.write(json.dumps(record, default=float) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self):
+        self._fh.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """Reference ``tracking.py:165-273``."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        super().__init__()
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir or ".", run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(values, metric_dict={})
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """Reference ``tracking.py:276-396``."""
+
+    name = "wandb"
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        import wandb
+
+        super().__init__()
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class CometMLTracker(GeneralTracker):
+    """Reference ``tracking.py:399-477``."""
+
+    name = "comet_ml"
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        from comet_ml import Experiment
+
+        super().__init__()
+        self.run_name = run_name
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.end()
+
+
+class AimTracker(GeneralTracker):
+    """Reference ``tracking.py:480-576``."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        from aim import Run
+
+        super().__init__()
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class MLflowTracker(GeneralTracker):
+    """Reference ``tracking.py:579-721``."""
+
+    name = "mlflow"
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        import mlflow
+
+        super().__init__()
+        experiment = mlflow.get_experiment_by_name(run_name)
+        exp_id = experiment.experiment_id if experiment else mlflow.create_experiment(run_name)
+        self.active_run = mlflow.start_run(run_name=run_name, experiment_id=exp_id, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for chunk in _chunk_dict(values, 100):
+            mlflow.log_params(chunk)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+class ClearMLTracker(GeneralTracker):
+    """Reference ``tracking.py:724-873``."""
+
+    name = "clearml"
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        from clearml import Task
+
+        super().__init__()
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clogger = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                if step is None:
+                    clogger.report_single_value(k, v, **kwargs)
+                else:
+                    title, _, series = k.partition("/")
+                    clogger.report_scalar(title, series or title, v, step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """Reference ``tracking.py:876-968``."""
+
+    name = "dvclive"
+
+    @on_main_process
+    def __init__(self, run_name: Optional[str] = None, live=None, **kwargs):
+        from dvclive import Live
+
+        super().__init__()
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+def _chunk_dict(d: dict, n: int):
+    items = list(d.items())
+    for i in range(0, len(items), n):
+        yield dict(items[i : i + n])
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "json": JSONTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "mlflow": MLflowTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
+
+_AVAILABILITY = {
+    "json": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "mlflow": is_mlflow_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+}
+
+
+def get_available_trackers() -> List[str]:
+    return [name for name, probe in _AVAILABILITY.items() if probe()]
+
+
+def filter_trackers(
+    log_with: List[Union[str, GeneralTracker]],
+    logging_dir: Optional[str],
+    project_name: str,
+    config: Optional[dict] = None,
+    init_kwargs: Optional[dict] = None,
+) -> List[GeneralTracker]:
+    """Resolve tracker names/instances, warn-and-drop unavailable ones
+    (reference ``filter_trackers``, ``tracking.py:971-1023``)."""
+    init_kwargs = init_kwargs or {}
+    trackers: List[GeneralTracker] = []
+    requested = log_with or []
+    if "all" in requested:
+        requested = get_available_trackers()
+    for entry in requested:
+        if isinstance(entry, GeneralTracker):
+            trackers.append(entry)
+            continue
+        name = str(entry)
+        if name not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(f"Unknown tracker {name!r}; choose from {sorted(LOGGER_TYPE_TO_CLASS)}")
+        if not _AVAILABILITY[name]():
+            logger.warning(f"Tried adding logger {name}, but the package is not installed; skipping.")
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[name]
+        kwargs = dict(init_kwargs.get(name, {}))
+        if cls.requires_logging_directory:
+            kwargs.setdefault("logging_dir", logging_dir)
+        trackers.append(cls(project_name, **kwargs))
+    for tracker in trackers:
+        if config:
+            tracker.store_init_configuration(config)
+    return trackers

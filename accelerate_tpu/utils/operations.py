@@ -387,7 +387,7 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
             return full * scale
         x = _to_numpy(x)
         if state.num_processes == 1:
-            return x * scale if reduction == "sum" else x
+            return x * scale
         stacked = multihost_utils.process_allgather(x[None], tiled=True)
         out = stacked.sum(axis=0) * scale
         if reduction == "mean":

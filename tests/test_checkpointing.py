@@ -1,0 +1,145 @@
+"""Checkpoint round-trip tests (reference: tests/test_state_checkpointing.py, 446 LoC)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, SimpleDataLoader
+from accelerate_tpu.checkpointing import (
+    _flatten_params,
+    _unflatten_params,
+    load_model_params,
+    parse_size,
+    save_model,
+)
+from accelerate_tpu.utils import ProjectConfiguration
+
+
+def _loss(p, batch):
+    return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+
+def _data(n=16):
+    rng = np.random.default_rng(0)
+    return [
+        {"x": rng.normal(size=(4,)).astype(np.float32), "y": rng.normal(size=(2,)).astype(np.float32)}
+        for _ in range(n)
+    ]
+
+
+def _make(tmp, **kw):
+    acc = Accelerator(**kw)
+    params = {"w": np.ones((4, 2), np.float32)}
+    state = acc.create_train_state(params=params, tx=optax.adamw(1e-2), seed=0)
+    return acc, state
+
+
+class TestSaveLoadState:
+    def test_round_trip(self, tmp_path):
+        acc, state = _make(tmp_path)
+        step = acc.compile_train_step(_loss)
+        dl = acc.prepare(SimpleDataLoader(_data(), batch_size=8, shuffle=True))
+        for b in dl:
+            state, _ = step(state, b)
+        out = acc.save_state(str(tmp_path / "ckpt"), state=state)
+        state2 = acc.create_train_state(params={"w": np.zeros((4, 2), np.float32)}, tx=optax.adamw(1e-2), seed=0)
+        state2 = acc.load_state(out, state=state2)
+        assert int(state2.step) == int(state.step)
+        np.testing.assert_allclose(np.asarray(state.params["w"]), np.asarray(state2.params["w"]))
+
+    def test_restore_preserves_sharding(self, tmp_path):
+        acc, state = _make(tmp_path, fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=4))
+        out = acc.save_state(str(tmp_path / "ckpt"), state=state)
+        state2 = acc.create_train_state(params={"w": np.zeros((4, 2), np.float32)}, tx=optax.adamw(1e-2), seed=0)
+        state2 = acc.load_state(out, state=state2)
+        assert state2.params["w"].sharding == state.params["w"].sharding
+
+    def test_automatic_naming_and_rotation(self, tmp_path):
+        acc, state = _make(
+            tmp_path,
+            project_config=ProjectConfiguration(
+                project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+            ),
+        )
+        for _ in range(3):
+            acc.save_state(state=state)
+        base = tmp_path / "checkpoints"
+        assert sorted(os.listdir(base)) == ["checkpoint_1", "checkpoint_2"]
+
+    def test_custom_objects(self, tmp_path):
+        acc, state = _make(tmp_path)
+
+        class Obj:
+            def __init__(self):
+                self.v = 3
+
+            def state_dict(self):
+                return {"v": self.v}
+
+            def load_state_dict(self, s):
+                self.v = s["v"]
+
+        o = Obj()
+        acc.register_for_checkpointing(o)
+        out = acc.save_state(str(tmp_path / "c"), state=state)
+        o.v = 0
+        acc.load_state(out, state=state)
+        assert o.v == 3
+
+    def test_register_invalid_object(self, tmp_path):
+        acc, _ = _make(tmp_path)
+        with pytest.raises(ValueError):
+            acc.register_for_checkpointing(object())
+
+    def test_sampler_state_round_trip(self, tmp_path):
+        acc, state = _make(tmp_path)
+        dl = acc.prepare(SimpleDataLoader(_data(), batch_size=4, shuffle=True))
+        list(dl)  # epoch 0 -> sampler.epoch stays, iteration advances
+        out = acc.save_state(str(tmp_path / "c"), state=state)
+        assert os.path.exists(os.path.join(out, "sampler_0.json"))
+
+
+class TestSaveModel:
+    def test_single_file(self, tmp_path):
+        acc, state = _make(tmp_path)
+        files = acc.save_model(state, str(tmp_path / "m"))
+        assert [os.path.basename(f) for f in files] == ["model.safetensors"]
+        back = load_model_params(str(tmp_path / "m"))
+        np.testing.assert_allclose(back["w"], np.asarray(jax.device_get(state.params["w"])))
+
+    def test_sharded_with_index(self, tmp_path):
+        acc, _ = _make(tmp_path)
+        params = {"a": np.ones((64, 64), np.float32), "b": np.ones((64, 64), np.float32)}
+        files = save_model(acc, params, str(tmp_path / "m"), max_shard_size=f"{64*64*4}B")
+        assert len(files) == 2
+        index = json.load(open(tmp_path / "m" / "model.safetensors.index.json"))
+        assert set(index["weight_map"]) == {"a", "b"}
+        back = load_model_params(str(tmp_path / "m"), target=params)
+        np.testing.assert_allclose(back["a"], params["a"])
+
+    def test_target_mismatch_raises(self, tmp_path):
+        acc, state = _make(tmp_path)
+        acc.save_model(state, str(tmp_path / "m"))
+        with pytest.raises(ValueError, match="mismatch"):
+            load_model_params(str(tmp_path / "m"), target={"other": np.ones(2)})
+
+
+def test_flatten_unflatten_inverse():
+    tree = {"a": {"b": np.ones(2), "c": {"d": np.zeros(3)}}, "e": np.ones(1)}
+    flat = _flatten_params(tree)
+    assert set(flat) == {"a.b", "a.c.d", "e"}
+    back = _unflatten_params(flat)
+    np.testing.assert_allclose(back["a"]["c"]["d"], tree["a"]["c"]["d"])
+
+
+def test_parse_size():
+    assert parse_size("10GB") == 10 * 10**9
+    assert parse_size("300B") == 300
+    assert parse_size(5) == 5
+    with pytest.raises(ValueError):
+        parse_size("ten gigs")
