@@ -76,6 +76,12 @@ from .utils.dataclasses import (
     parse_flag_from_env,
 )
 
+def _strip_memory_kind(s):
+    if isinstance(s, NamedSharding) and s.memory_kind not in (None, "device"):
+        return NamedSharding(s.mesh, s.spec)
+    return s
+
+
 def _is_dataloader_like(obj) -> bool:
     if isinstance(obj, (DataLoaderShard, DataLoaderDispatcher)):
         return True
@@ -440,8 +446,7 @@ class Accelerator:
 
         abstract = jax.eval_shape(init_fn, params)
         shardings = self._train_state_shardings(abstract)
-        state = jax.jit(init_fn, out_shardings=shardings)(params)
-        return state
+        return self._place_with_offload(init_fn, params, shardings)
 
     def _train_state_shardings(self, abstract_state):
         param_rule = make_param_sharding_fn(self.mesh, self.effective_fsdp_plugin)
@@ -453,8 +458,12 @@ class Accelerator:
             name = getattr(root, "name", getattr(root, "key", None))
             if name == "params":
                 return param_rule(x)
-            if name in ("opt_state", "grad_accum"):
+            if name == "opt_state":
                 return opt_rule(x)
+            if name == "grad_accum":
+                # grads are touched every micro-step: keep them in HBM even when
+                # the optimizer state is host-offloaded
+                return _strip_memory_kind(opt_rule(x))
             return replicated
 
         return jax.tree_util.tree_map_with_path(rule, abstract_state)
@@ -462,8 +471,28 @@ class Accelerator:
     def _shard_train_state(self, state: TrainState) -> TrainState:
         abstract = jax.eval_shape(lambda s: s, state)
         shardings = self._train_state_shardings(abstract)
-        sharded = jax.jit(lambda s: s, out_shardings=shardings)(state)
-        return sharded
+        return self._place_with_offload(lambda s: s, state, shardings)
+
+    def _place_with_offload(self, init_fn, operand, shardings):
+        """jit into device shardings, then move host-offloaded leaves out of HBM.
+
+        XLA cannot jit-emit host-memory outputs directly (annotate_device_placement
+        needs sharded side-effect ops), hence the two-phase placement.
+        """
+        device_shardings = jax.tree_util.tree_map(_strip_memory_kind, shardings)
+        placed = jax.jit(init_fn, out_shardings=device_shardings)(operand)
+        if any(
+            getattr(s, "memory_kind", None) == "pinned_host"
+            for s in jax.tree_util.tree_leaves(
+                shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+            )
+        ):
+            placed = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s) if isinstance(x, jax.Array) else x,
+                placed,
+                shardings,
+            )
+        return placed
 
     # ------------------------------------------------------------- step build
     def _wrap_loss_fn(self, loss_fn: Callable, has_aux: bool):
@@ -532,7 +561,32 @@ class Accelerator:
                 stacklevel=2,
             )
 
+        plugin = self.effective_fsdp_plugin
+        from .parallel.sharding import supports_host_offload
+
+        offloading_ok = supports_host_offload(self.mesh)
+        offload_opt = plugin is not None and plugin.offload_optimizer and offloading_ok
+        offload_params = plugin is not None and plugin.cpu_offload and offloading_ok
+        if plugin is not None and (plugin.offload_optimizer or plugin.cpu_offload) and not offloading_ok:
+            import warnings
+
+            warnings.warn(
+                "Host-memory offload requires the TPU runtime; keeping state in device "
+                "memory on this backend.",
+                stacklevel=2,
+            )
+        if offload_opt or offload_params:
+            donate = False  # donation of host-resident buffers is rejected by XLA
+
         def _step(state: TrainState, batch, force_sync):
+            from jax.memory import Space
+
+            # Host-offloaded params stream to HBM for the step and back after
+            # (ZeRO-offload; reference DeepSpeedPlugin.offload_*_device).  The
+            # optimizer state is only touched inside the apply branch below, so
+            # its round-trip happens exclusively on sync steps.
+            if offload_params:
+                state = state.replace(params=jax.device_put(state.params, Space.Device))
             batch = self._constrain_batch(batch)
             if state.rng is not None:
                 new_rng, sub = jax.random.split(state.rng)
@@ -569,7 +623,11 @@ class Accelerator:
 
             def do_apply(operand):
                 st, g = operand
+                if offload_opt:
+                    st = st.replace(opt_state=jax.device_put(st.opt_state, Space.Device))
                 new = st.apply_gradients(g)
+                if offload_opt:
+                    new = new.replace(opt_state=jax.device_put(new.opt_state, Space.Host))
                 return new
 
             def skip_apply(operand):
@@ -596,6 +654,9 @@ class Accelerator:
                     state.loss_scale,
                 )
                 new_state = new_state.replace(loss_scale=new_scale)
+
+            if offload_params:
+                new_state = new_state.replace(params=jax.device_put(new_state.params, Space.Host))
 
             metrics = {
                 "loss": loss,

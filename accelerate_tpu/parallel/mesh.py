@@ -90,12 +90,17 @@ def build_mesh(
         # Hybrid mesh: dcn axes across slices/hosts, remaining within a slice.
         ici_shape = [axes[k] // dcn_axes.get(k, 1) for k in names]
         dcn_shape = [dcn_axes.get(k, 1) for k in names]
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            ici_shape,
-            dcn_shape,
-            devices=devices,
-            allow_split_physical_axes=allow_split_physical_axes,
-        )
+        if all(d.platform == "cpu" for d in devices):
+            # CPU test meshes have no slice topology; emulate with a flat layout.
+            dev_array = np.array(devices).reshape(shape)
+        else:
+            # On real pods, let genuine slice/config mismatches surface.
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                dcn_shape,
+                devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
         return Mesh(dev_array, names)
 
     if all(d.platform == "cpu" for d in devices):

@@ -47,17 +47,24 @@ def make_param_sharding_fn(
     mesh: Mesh,
     plugin: Optional[FullyShardedDataParallelPlugin] = None,
 ) -> Callable[[Any], NamedSharding]:
-    """Build shape -> NamedSharding for parameters."""
+    """Build shape -> NamedSharding for parameters.
+
+    With ``plugin.cpu_offload`` the sharded params live in ``pinned_host`` memory
+    (the ZeRO param-offload analog, reference ``DeepSpeedPlugin.offload_param_device``);
+    XLA streams them to HBM on use.
+    """
     fsdp_size = mesh_lib.mesh_axis_size(mesh, "fsdp")
     shards_params = plugin is not None and plugin.shards_params and fsdp_size > 1
+    memory_kind = "pinned_host" if (plugin is not None and plugin.cpu_offload) else None
 
     def rule(x) -> NamedSharding:
         shape = getattr(x, "shape", ())
-        if shards_params:
-            return NamedSharding(
-                mesh, fsdp_partition_spec(shape, fsdp_size, plugin.min_weight_size)
-            )
-        return NamedSharding(mesh, PartitionSpec())
+        spec = (
+            fsdp_partition_spec(shape, fsdp_size, plugin.min_weight_size)
+            if shards_params
+            else PartitionSpec()
+        )
+        return _named_sharding(mesh, spec, memory_kind)
 
     return rule
 
@@ -69,19 +76,37 @@ def make_opt_sharding_fn(
     """Optimizer-state rule: sharded whenever the strategy shards opt state (ZeRO>=1).
 
     Applied by shape, so Adam's ``mu``/``nu`` (param-shaped) shard exactly like the
-    matching param would under FULL_SHARD, while scalars stay replicated.
+    matching param would under FULL_SHARD, while scalars stay replicated.  With
+    ``plugin.offload_optimizer`` the state lives in ``pinned_host`` memory
+    (DeepSpeedCPUAdam analog — XLA fuses the host<->HBM streaming into the step).
     """
     fsdp_size = mesh_lib.mesh_axis_size(mesh, "fsdp")
     shards_opt = plugin is not None and plugin.shards_opt_state and fsdp_size > 1
     min_size = plugin.min_weight_size if plugin is not None else 2**12
+    memory_kind = "pinned_host" if (plugin is not None and plugin.offload_optimizer) else None
 
     def rule(x) -> NamedSharding:
         shape = getattr(x, "shape", ())
-        if shards_opt:
-            return NamedSharding(mesh, fsdp_partition_spec(shape, fsdp_size, min_size))
-        return NamedSharding(mesh, PartitionSpec())
+        spec = fsdp_partition_spec(shape, fsdp_size, min_size) if shards_opt else PartitionSpec()
+        return _named_sharding(mesh, spec, memory_kind)
 
     return rule
+
+
+def supports_host_offload(mesh: Mesh) -> bool:
+    """Host-memory state offload needs the TPU runtime (XLA's CPU SPMD partitioner
+    rejects host-placed jit outputs; verified empirically)."""
+    try:
+        dev = next(iter(np.asarray(mesh.devices).flat))
+    except StopIteration:
+        return False
+    return dev.platform in ("tpu", "axon")
+
+
+def _named_sharding(mesh: Mesh, spec: PartitionSpec, memory_kind: Optional[str]) -> NamedSharding:
+    if memory_kind is None or not supports_host_offload(mesh):
+        return NamedSharding(mesh, spec)
+    return NamedSharding(mesh, spec, memory_kind=memory_kind)
 
 
 def shard_pytree(tree, rule: Callable[[Any], NamedSharding]):

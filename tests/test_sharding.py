@@ -1,0 +1,115 @@
+"""FSDP/ZeRO sharding-rule tests (reference: tests/fsdp/test_fsdp.py strategy matrix,
+tests/deepspeed/test_deepspeed.py stage mapping — here as pure placement checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec
+
+from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin, ZeroPlugin
+from accelerate_tpu.parallel.mesh import build_mesh
+from accelerate_tpu.parallel.sharding import fsdp_partition_spec, supports_host_offload
+from accelerate_tpu.utils import ShardingStrategy
+
+
+class TestFsdpPartitionSpec:
+    def test_shards_largest_divisible_dim(self):
+        assert fsdp_partition_spec((128, 64), 8, 0) == PartitionSpec("fsdp", None)
+        assert fsdp_partition_spec((64, 128), 8, 0) == PartitionSpec(None, "fsdp")
+
+    def test_small_params_replicated(self):
+        assert fsdp_partition_spec((4, 4), 8, min_weight_size=2**12) == PartitionSpec()
+
+    def test_indivisible_falls_back_to_next_dim(self):
+        # 10 not divisible by 8, 64 is
+        assert fsdp_partition_spec((10, 64), 8, 0) == PartitionSpec(None, "fsdp")
+
+    def test_nothing_divisible_replicates(self):
+        assert fsdp_partition_spec((7, 9), 8, 0) == PartitionSpec()
+
+    def test_fsdp_size_one_replicates(self):
+        assert fsdp_partition_spec((128, 64), 1, 0) == PartitionSpec()
+
+
+def _state_for(strategy):
+    acc = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(sharding_strategy=strategy, min_weight_size=8)
+    )
+    params = {"w": jnp.ones((16, 8)), "tiny": jnp.ones((2,))}
+    return acc.create_train_state(params=params, tx=optax.adamw(1e-3))
+
+
+class TestStrategies:
+    def test_full_shard(self):
+        state = _state_for(ShardingStrategy.FULL_SHARD)
+        assert "fsdp" in str(state.params["w"].sharding.spec)
+        mu_specs = [
+            str(x.sharding.spec)
+            for x in jax.tree_util.tree_leaves(state.opt_state)
+            if hasattr(x, "sharding") and x.shape == (16, 8)
+        ]
+        assert all("fsdp" in s for s in mu_specs)
+
+    def test_shard_grad_op_params_replicated(self):
+        state = _state_for(ShardingStrategy.SHARD_GRAD_OP)
+        assert str(state.params["w"].sharding.spec) == "PartitionSpec()"
+        mu_specs = [
+            str(x.sharding.spec)
+            for x in jax.tree_util.tree_leaves(state.opt_state)
+            if hasattr(x, "sharding") and x.shape == (16, 8)
+        ]
+        assert all("fsdp" in s for s in mu_specs)
+
+    def test_no_shard_all_replicated(self):
+        state = _state_for(ShardingStrategy.NO_SHARD)
+        specs = {
+            str(x.sharding.spec)
+            for x in jax.tree_util.tree_leaves((state.params, state.opt_state))
+            if hasattr(x, "sharding")
+        }
+        assert specs == {"PartitionSpec()"}
+
+    def test_small_params_replicated_under_full_shard(self):
+        state = _state_for(ShardingStrategy.FULL_SHARD)
+        assert str(state.params["tiny"].sharding.spec) == "PartitionSpec()"
+
+
+class TestZeroMapping:
+    @pytest.mark.parametrize(
+        "stage,shards_params,shards_opt",
+        [(0, False, False), (1, False, True), (2, False, True), (3, True, True)],
+    )
+    def test_stage_mapping(self, stage, shards_params, shards_opt):
+        fsdp = ZeroPlugin(zero_stage=stage).to_fsdp_plugin()
+        assert fsdp.shards_params == shards_params
+        assert fsdp.shards_opt_state == shards_opt
+
+    def test_invalid_stage(self):
+        with pytest.raises(ValueError):
+            ZeroPlugin(zero_stage=5)
+
+
+class TestHybridMesh:
+    def test_hybrid_mesh_builds(self):
+        mesh = build_mesh({"dp": 2, "fsdp": 4}, dcn_axes={"dp": 2})
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 4}
+
+    def test_offload_not_supported_on_cpu(self):
+        mesh = build_mesh({"dp": 8})
+        assert not supports_host_offload(mesh)
+
+    def test_offload_falls_back_with_warning(self):
+        acc = Accelerator(
+            deepspeed_plugin=ZeroPlugin(zero_stage=2, offload_optimizer_device="cpu")
+        )
+        state = acc.create_train_state(params={"w": jnp.ones((16, 8))}, tx=optax.adamw(1e-3))
+        kinds = {
+            x.sharding.memory_kind
+            for x in jax.tree_util.tree_leaves(state.opt_state)
+            if hasattr(x, "sharding")
+        }
+        assert kinds == {"device"}  # fallback on the CPU backend
+        with pytest.warns(UserWarning, match="TPU runtime"):
+            acc.compile_train_step(lambda p, b: jnp.mean((b["x"] @ p["w"]) ** 2))
