@@ -1,0 +1,478 @@
+"""Hand-written pallas flash attention for TPU (fwd + bwd, causal, GQA, segments).
+
+The reference delegates fused attention to CUDA backends (Megatron fused
+kernels, ``utils/megatron_lm.py``); this is the TPU equivalent, written as a
+Mosaic/pallas kernel: online-softmax tiling so the full ``[S, S]`` score matrix
+never materializes in HBM, fp32 accumulation on the MXU, and a custom VJP whose
+backward recomputes probabilities blockwise from the saved logsumexp (the
+standard flash-attention-2 scheme).
+
+Layout notes (TPU tiling):
+  - per-row stats (logsumexp, delta) are carried as ``[rows, 128]``
+    lane-broadcast tiles — column slices of narrower width don't relayout well;
+  - segment ids are pre-broadcast to ``[B, Sq, 128]`` (q, lane-replicated) and
+    ``[B, 8, Sk]`` (kv, sublane-replicated) so the mask compare is elementwise;
+  - grid iteration order puts the reduction dimension innermost; VMEM scratch
+    accumulators persist across it.
+
+Public entry: :func:`flash_attention` (BSHD, matching ``ops.attention``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NUM_LANES = 128
+NUM_SUBLANES = 8
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+class _Config(NamedTuple):
+    causal: bool
+    scale: float
+    block_q: int
+    block_k: int
+    block_q_bwd: int
+    block_k_bwd: int
+    interpret: bool
+
+
+def _default_interpret() -> bool:
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def _pick_block(seq: int, target: int) -> int:
+    if seq <= target:
+        return seq
+    for b in (target, 512, 256, 128):
+        if b <= seq and seq % b == 0:
+            return b
+    raise ValueError(
+        f"sequence length {seq} must be a multiple of 128 (or <= block size) "
+        "for the pallas flash attention kernel"
+    )
+
+
+def _broadcast_segments(segment_ids: jax.Array, sq: int, sk: int):
+    """[B, S] -> lane-replicated q ids [B, Sq, 128] and sublane-replicated kv ids [B, 8, Sk]."""
+    q_ids = jax.lax.broadcast_in_dim(segment_ids[:, :sq], (segment_ids.shape[0], sq, NUM_LANES), (0, 1))
+    kv_ids = jax.lax.broadcast_in_dim(segment_ids[:, :sk], (segment_ids.shape[0], NUM_SUBLANES, sk), (0, 2))
+    return q_ids.astype(jnp.int32), kv_ids.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------- forward
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, out_ref, lse_ref,
+    acc_ref, m_ref, l_ref, *, causal: bool, scale: float, block_q: int, block_k: int,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    should_run = True
+    if causal:
+        should_run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale
+
+        mask = None
+        if qseg_ref is not None:
+            repeats = block_k // NUM_LANES
+            if repeats:
+                q_ids = jnp.tile(qseg_ref[0], (1, repeats))
+            else:
+                q_ids = qseg_ref[0][:, :block_k]
+            kv_ids = kseg_ref[0, :1, :]
+            mask = jnp.equal(q_ids, kv_ids)
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            cmask = cols <= rows
+            mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+        if mask is not None:
+            s = s + jnp.where(mask, 0.0, DEFAULT_MASK_VALUE)
+
+        m_prev = m_ref[...]  # [block_q, 128]
+        l_prev = l_ref[...]
+        m_curr = jnp.max(s, axis=1)[:, None]  # [block_q, 1]
+        m_next = jnp.maximum(m_prev, m_curr)  # [block_q, 128]
+        repeats_k = block_k // NUM_LANES
+        if repeats_k:
+            m_tiled = jnp.tile(m_next[:, :1], (1, block_k))
+        else:
+            m_tiled = m_next[:, :block_k]
+        p = jnp.exp(s - m_tiled)
+        alpha = jnp.exp(m_prev - m_next)  # [block_q, 128]
+        l_next = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_ref[...] = m_next
+        l_ref[...] = l_next
+
+        head_dim = acc_ref.shape[-1]
+        if head_dim >= NUM_LANES:
+            a_bcast = lambda a: jnp.tile(a[:, :1], (1, head_dim))
+        else:
+            a_bcast = lambda a: a[:, :head_dim]
+        v = v_ref[0, 0]
+        pv = jax.lax.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * a_bcast(alpha) + pv
+
+    @pl.when(ik == n_k - 1)
+    def _store():
+        l = l_ref[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        head_dim = acc_ref.shape[-1]
+        if head_dim >= NUM_LANES:
+            inv = jnp.tile(1.0 / l_safe[:, :1], (1, head_dim))
+        else:
+            inv = 1.0 / l_safe[:, :head_dim]
+        out_ref[0, 0] = (acc_ref[...] * inv).astype(out_ref.dtype)
+        lse_ref[0, 0] = m_ref[...] + jnp.log(l_safe)
+
+
+def _flash_fwd_bhsd(q, k, v, segments, cfg: _Config):
+    """q: [B, Hq, Sq, D]; k/v: [B, Hkv, Sk, D] (GQA via index map, no materialization)."""
+    batch, n_heads, sq, head_dim = q.shape
+    n_kv = k.shape[1]
+    sk = k.shape[2]
+    rep = n_heads // n_kv
+    bq = _pick_block(sq, cfg.block_q)
+    bk = _pick_block(sk, cfg.block_k)
+    grid = (batch, n_heads, sq // bq, sk // bk)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, head_dim), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bk, head_dim), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+        pl.BlockSpec((1, 1, bk, head_dim), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+    ]
+    operands = [q, k, v]
+    if segments is not None:
+        q_ids, kv_ids = segments
+        in_specs += [
+            pl.BlockSpec((1, bq, NUM_LANES), lambda b, h, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, NUM_SUBLANES, bk), lambda b, h, iq, ik: (b, 0, ik)),
+        ]
+        operands += [q_ids, kv_ids]
+        kernel = functools.partial(
+            _fwd_kernel, causal=cfg.causal, scale=cfg.scale, block_q=bq, block_k=bk
+        )
+    else:
+        base = functools.partial(
+            _fwd_kernel, causal=cfg.causal, scale=cfg.scale, block_q=bq, block_k=bk
+        )
+
+        def kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref):
+            return base(q_ref, k_ref, v_ref, None, None, out_ref, lse_ref, acc_ref, m_ref, l_ref)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, head_dim), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, NUM_LANES), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, n_heads, sq, NUM_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, head_dim), jnp.float32),
+            pltpu.VMEM((bq, NUM_LANES), jnp.float32),
+            pltpu.VMEM((bq, NUM_LANES), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(*operands)
+    return out, lse
+
+
+# -------------------------------------------------------------------- backward
+def _attn_block(q, k, dout, v, lse_slice, delta_slice, qseg_ref, kseg_ref,
+                iq, ik, *, causal, scale, block_q, block_k):
+    """Recompute p and ds for one (q-block, k-block) tile. Returns (p, ds) fp32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s *= scale
+    mask = None
+    if qseg_ref is not None:
+        repeats = block_k // NUM_LANES
+        if repeats:
+            q_ids = jnp.tile(qseg_ref[0], (1, repeats))
+        else:
+            q_ids = qseg_ref[0][:, :block_k]
+        kv_ids = kseg_ref[0, :1, :]
+        mask = jnp.equal(q_ids, kv_ids)
+    if causal:
+        rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        cmask = cols <= rows
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+    if mask is not None:
+        s = s + jnp.where(mask, 0.0, DEFAULT_MASK_VALUE)
+
+    p = jnp.exp(s - lse_slice)  # normalized probabilities [bq, bk]
+    dp = jax.lax.dot_general(
+        dout, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_slice) * scale
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+               dq_ref, dq_acc, *, causal, scale, block_q, block_k):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    should_run = True
+    if causal:
+        should_run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q, k, v, dout = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
+        repeats_k = block_k // NUM_LANES
+        if repeats_k:
+            lse_slice = jnp.tile(lse_ref[0, 0][:, :1], (1, block_k))
+            delta_slice = jnp.tile(delta_ref[0, 0][:, :1], (1, block_k))
+        else:
+            lse_slice = lse_ref[0, 0][:, :block_k]
+            delta_slice = delta_ref[0, 0][:, :block_k]
+        _, ds = _attn_block(
+            q, k, dout, v, lse_slice, delta_slice, qseg_ref, kseg_ref, iq, ik,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        )
+        dq_acc[...] += jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == n_k - 1)
+    def _store():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, block_q, block_k):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    should_run = True
+    if causal:
+        should_run = iq * block_q + block_q - 1 >= ik * block_k
+
+    @pl.when(should_run)
+    def _compute():
+        q, k, v, dout = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
+        repeats_k = block_k // NUM_LANES
+        if repeats_k:
+            lse_slice = jnp.tile(lse_ref[0, 0][:, :1], (1, block_k))
+            delta_slice = jnp.tile(delta_ref[0, 0][:, :1], (1, block_k))
+        else:
+            lse_slice = lse_ref[0, 0][:, :block_k]
+            delta_slice = delta_ref[0, 0][:, :block_k]
+        p, ds = _attn_block(
+            q, k, dout, v, lse_slice, delta_slice, qseg_ref, kseg_ref, iq, ik,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        )
+        # dk = ds^T @ q ; dv = p^T @ dout  (contract over the q rows)
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(dout.dtype), dout, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == n_q - 1)
+    def _store():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(q, k, v, segments, out, lse, dout, cfg: _Config):
+    """Backward over [B, H, S, D] tensors with matched q/kv head counts."""
+    batch, n_heads, sq, head_dim = q.shape
+    sk = k.shape[2]
+    # The bwd kernels hold ~4x the fp32 temporaries of fwd (s, p, dp, ds plus two
+    # accumulators); 256-blocks blow the 16MB scoped-VMEM budget on v5e.
+    bq = _pick_block(sq, cfg.block_q_bwd)
+    bk = _pick_block(sk, cfg.block_k_bwd)
+
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jax.lax.broadcast_in_dim(
+        delta, (batch, n_heads, sq, NUM_LANES), (0, 1, 2)
+    )
+
+    def seg_specs(iq_of, ik_of):
+        return [
+            pl.BlockSpec((1, bq, NUM_LANES), lambda b, h, i, j: (b, iq_of(i, j), 0)),
+            pl.BlockSpec((1, NUM_SUBLANES, bk), lambda b, h, i, j: (b, 0, ik_of(i, j))),
+        ]
+
+    def common_specs(iq_of, ik_of):
+        return [
+            pl.BlockSpec((1, 1, bq, head_dim), lambda b, h, i, j: (b, h, iq_of(i, j), 0)),
+            pl.BlockSpec((1, 1, bk, head_dim), lambda b, h, i, j: (b, h, ik_of(i, j), 0)),
+            pl.BlockSpec((1, 1, bk, head_dim), lambda b, h, i, j: (b, h, ik_of(i, j), 0)),
+            pl.BlockSpec((1, 1, bq, head_dim), lambda b, h, i, j: (b, h, iq_of(i, j), 0)),
+            pl.BlockSpec((1, 1, bq, NUM_LANES), lambda b, h, i, j: (b, h, iq_of(i, j), 0)),
+            pl.BlockSpec((1, 1, bq, NUM_LANES), lambda b, h, i, j: (b, h, iq_of(i, j), 0)),
+        ]
+
+    operands = [q, k, v, dout, lse, delta]
+    has_seg = segments is not None
+    if has_seg:
+        operands += list(segments)
+
+    def adapt(kernel_fn):
+        if has_seg:
+            return kernel_fn
+
+        def wrapped(*refs):
+            ins, outs_scratch = refs[:6], refs[6:]
+            return kernel_fn(*ins, None, None, *outs_scratch)
+
+        return wrapped
+
+    kw = dict(causal=cfg.causal, scale=cfg.scale, block_q=bq, block_k=bk)
+
+    # dq: reduce over kv blocks (innermost)
+    iq_of, ik_of = (lambda i, j: i), (lambda i, j: j)
+    dq = pl.pallas_call(
+        adapt(functools.partial(_dq_kernel, **kw)),
+        grid=(batch, n_heads, sq // bq, sk // bk),
+        in_specs=common_specs(iq_of, ik_of) + (seg_specs(iq_of, ik_of) if has_seg else []),
+        out_specs=pl.BlockSpec((1, 1, bq, head_dim), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
+        interpret=cfg.interpret,
+    )(*operands)
+
+    # dk/dv: reduce over q blocks (innermost); grid dims are (ik, iq)
+    iq_of, ik_of = (lambda i, j: j), (lambda i, j: i)
+    dk, dv = pl.pallas_call(
+        adapt(functools.partial(_dkv_kernel, **kw)),
+        grid=(batch, n_heads, sk // bk, sq // bq),
+        in_specs=common_specs(iq_of, ik_of) + (seg_specs(iq_of, ik_of) if has_seg else []),
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, head_dim), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, head_dim), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, head_dim), jnp.float32),
+            pltpu.VMEM((bk, head_dim), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(*operands)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------- custom vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash(q, k, v, segments, cfg: _Config):
+    out, _ = _flash_fwd_bhsd(q, k, v, segments, cfg)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, segments, cfg: _Config):
+    out, lse = _flash_fwd_bhsd(q, k, v, segments, cfg)
+    return out, (q, k, v, segments, out, lse)
+
+
+def _flash_bwd_rule(cfg: _Config, residuals, dout):
+    q, k, v, segments, out, lse = residuals
+    n_heads, n_kv = q.shape[1], k.shape[1]
+    rep = n_heads // n_kv
+    if rep > 1:
+        k_full = jnp.repeat(k, rep, axis=1)
+        v_full = jnp.repeat(v, rep, axis=1)
+    else:
+        k_full, v_full = k, v
+    dq, dk, dv = _flash_bwd_bhsd(q, k_full, v_full, segments, out, lse, dout, cfg)
+    if rep > 1:
+        b, _, s, d = dk.shape
+        dk = dk.reshape(b, n_kv, rep, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, n_kv, rep, s, d).sum(axis=2).astype(v.dtype)
+    if segments is not None:
+        import numpy as np
+
+        d_segments = jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, jax.dtypes.float0), segments
+        )
+    else:
+        d_segments = None
+    return dq, dk, dv, d_segments
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# -------------------------------------------------------------------- public
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    block_q_bwd: int = 128,
+    block_k_bwd: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over BSHD tensors ``[batch, seq, heads, head_dim]``.
+
+    GQA is supported (k/v may have fewer heads, dividing q heads).
+    ``segment_ids`` is ``[batch, seq]`` int32; tokens attend only within equal
+    ids (packed-sequence masking), composed with the causal mask.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+
+    q_b = jnp.swapaxes(q, 1, 2)
+    k_b = jnp.swapaxes(k, 1, 2)
+    v_b = jnp.swapaxes(v, 1, 2)
+    segments = None
+    if segment_ids is not None:
+        segments = _broadcast_segments(segment_ids, q.shape[1], k.shape[1])
+
+    cfg = _Config(
+        bool(causal), scale, int(block_q), int(block_k),
+        int(block_q_bwd), int(block_k_bwd), bool(interpret),
+    )
+    out = _flash(q_b, k_b, v_b, segments, cfg)
+    return jnp.swapaxes(out, 1, 2)
