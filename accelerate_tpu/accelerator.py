@@ -495,6 +495,29 @@ class Accelerator:
         return placed
 
     # ------------------------------------------------------------- step build
+    def _offload_flags(self, warn: bool = False):
+        """(offload_params, offload_opt) per the active plugin and backend support."""
+        plugin = self.effective_fsdp_plugin
+        from .parallel.sharding import supports_host_offload
+
+        offloading_ok = supports_host_offload(self.mesh)
+        offload_opt = plugin is not None and plugin.offload_optimizer and offloading_ok
+        offload_params = plugin is not None and plugin.cpu_offload and offloading_ok
+        if (
+            warn
+            and plugin is not None
+            and (plugin.offload_optimizer or plugin.cpu_offload)
+            and not offloading_ok
+        ):
+            import warnings
+
+            warnings.warn(
+                "Host-memory offload requires the TPU runtime; keeping state in device "
+                "memory on this backend.",
+                stacklevel=3,
+            )
+        return offload_params, offload_opt
+
     def _wrap_loss_fn(self, loss_fn: Callable, has_aux: bool):
         """Normalize loss_fn(params, batch[, rng]) and apply the precision policy."""
         try:
@@ -561,20 +584,7 @@ class Accelerator:
                 stacklevel=2,
             )
 
-        plugin = self.effective_fsdp_plugin
-        from .parallel.sharding import supports_host_offload
-
-        offloading_ok = supports_host_offload(self.mesh)
-        offload_opt = plugin is not None and plugin.offload_optimizer and offloading_ok
-        offload_params = plugin is not None and plugin.cpu_offload and offloading_ok
-        if plugin is not None and (plugin.offload_optimizer or plugin.cpu_offload) and not offloading_ok:
-            import warnings
-
-            warnings.warn(
-                "Host-memory offload requires the TPU runtime; keeping state in device "
-                "memory on this backend.",
-                stacklevel=2,
-            )
+        offload_params, offload_opt = self._offload_flags(warn=True)
         if offload_opt or offload_params:
             donate = False  # donation of host-resident buffers is rejected by XLA
 
@@ -692,9 +702,14 @@ class Accelerator:
     def compile_eval_step(self, eval_fn: Callable, *, donate: bool = False) -> Callable:
         """Compile an eval/predict step: ``eval_fn(params, batch[, rng])`` with policy cast."""
         wrapped = self._wrap_loss_fn(eval_fn, has_aux=False)
+        offload_params, _ = self._offload_flags()
 
         def _step(state_or_params, batch):
             params = state_or_params.params if isinstance(state_or_params, TrainState) else state_or_params
+            if offload_params:
+                from jax.memory import Space
+
+                params = jax.device_put(params, Space.Device)
             batch = self._constrain_batch(batch)
             out, _ = wrapped(params, batch, None)
             return self.policy.cast_to_output(out)
@@ -738,8 +753,13 @@ class Accelerator:
         key = ("grad", loss_fn, has_aux)
         if key not in self._jit_cache:
             wrapped = self._wrap_loss_fn(loss_fn, has_aux)
+            offload_params, _ = self._offload_flags()
 
             def _grad(state, batch):
+                if offload_params:
+                    from jax.memory import Space
+
+                    state = state.replace(params=jax.device_put(state.params, Space.Device))
                 if state.rng is not None:
                     _, sub = jax.random.split(state.rng)
                 else:
@@ -772,6 +792,8 @@ class Accelerator:
 
     def apply_gradients(self, state: TrainState, grads, max_grad_norm: Optional[float] = None):
         """Apply (or accumulate) gradients per ``GradientState.sync_gradients``."""
+        offload_params, offload_opt = self._offload_flags()
+        offloading = offload_params or offload_opt
         if not self.sync_gradients:
             key = "accumulate_grads"
             if key not in self._jit_cache:
@@ -783,11 +805,20 @@ class Accelerator:
                         return state.replace(grad_accum=acc, micro_step=state.micro_step + 1, rng=new_rng)
                     return state.replace(micro_step=state.micro_step + 1, rng=new_rng)
 
-                self._jit_cache[key] = jax.jit(_acc, donate_argnums=(0,))
+                self._jit_cache[key] = jax.jit(_acc, donate_argnums=() if offloading else (0,))
             return self._jit_cache[key](state, grads)
         key = ("apply_grads", max_grad_norm)
         if key not in self._jit_cache:
             def _apply(state, grads):
+                if offloading:
+                    # Stream host-offloaded leaves to HBM for the update and back
+                    # (same round-trip the compiled step does on sync steps).
+                    from jax.memory import Space
+
+                    if offload_params:
+                        state = state.replace(params=jax.device_put(state.params, Space.Device))
+                    if offload_opt:
+                        state = state.replace(opt_state=jax.device_put(state.opt_state, Space.Device))
                 count = state.micro_step + 1
                 if state.grad_accum is not None:
                     grads = jax.tree_util.tree_map(lambda a, g: a + g, state.grad_accum, grads)
@@ -808,9 +839,16 @@ class Accelerator:
                     new = new.replace(loss_scale=state.loss_scale.update(finite))
                 if state.rng is not None:
                     new = new.replace(rng=jax.random.split(state.rng)[0])
+                if offloading:
+                    from jax.memory import Space
+
+                    if offload_params:
+                        new = new.replace(params=jax.device_put(new.params, Space.Host))
+                    if offload_opt:
+                        new = new.replace(opt_state=jax.device_put(new.opt_state, Space.Host))
                 return new.replace(micro_step=jnp.zeros((), jnp.int32))
 
-            self._jit_cache[key] = jax.jit(_apply, donate_argnums=(0,))
+            self._jit_cache[key] = jax.jit(_apply, donate_argnums=() if offloading else (0,))
         return self._jit_cache[key](state, grads)
 
     def clip_grad_norm_(self, grads, max_norm: float, norm_type: float = 2.0):

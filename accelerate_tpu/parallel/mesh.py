@@ -85,13 +85,23 @@ def build_mesh(
     if used < n:
         devices = devices[:used]
         n = used
+    # CPU test meshes have no interconnect topology; reshape flat so the same
+    # config validates on the test rig and lays out physically on real pods.
+    is_cpu = all(d.platform == "cpu" for d in devices)
 
     if dcn_axes:
         # Hybrid mesh: dcn axes across slices/hosts, remaining within a slice.
+        unknown = set(dcn_axes) - set(names)
+        if unknown:
+            raise ValueError(f"dcn_axes {sorted(unknown)} not present in mesh axes {names}")
+        for k, dcn in dcn_axes.items():
+            if dcn <= 0 or axes[k] % dcn != 0:
+                raise ValueError(
+                    f"dcn size {dcn} for axis {k!r} must divide its total size {axes[k]}"
+                )
         ici_shape = [axes[k] // dcn_axes.get(k, 1) for k in names]
         dcn_shape = [dcn_axes.get(k, 1) for k in names]
-        if all(d.platform == "cpu" for d in devices):
-            # CPU test meshes have no slice topology; emulate with a flat layout.
+        if is_cpu:
             dev_array = np.array(devices).reshape(shape)
         else:
             # On real pods, let genuine slice/config mismatches surface.
@@ -103,8 +113,7 @@ def build_mesh(
             )
         return Mesh(dev_array, names)
 
-    if all(d.platform == "cpu" for d in devices):
-        # mesh_utils assumes real interconnect topology; CPU test meshes reshape flat.
+    if is_cpu:
         dev_array = np.array(devices).reshape(shape)
     else:
         try:

@@ -56,6 +56,8 @@ def make_param_sharding_fn(
     fsdp_size = mesh_lib.mesh_axis_size(mesh, "fsdp")
     shards_params = plugin is not None and plugin.shards_params and fsdp_size > 1
     memory_kind = "pinned_host" if (plugin is not None and plugin.cpu_offload) else None
+    if memory_kind is not None and not supports_host_offload(mesh):
+        memory_kind = None
 
     def rule(x) -> NamedSharding:
         shape = getattr(x, "shape", ())
@@ -84,6 +86,8 @@ def make_opt_sharding_fn(
     shards_opt = plugin is not None and plugin.shards_opt_state and fsdp_size > 1
     min_size = plugin.min_weight_size if plugin is not None else 2**12
     memory_kind = "pinned_host" if (plugin is not None and plugin.offload_optimizer) else None
+    if memory_kind is not None and not supports_host_offload(mesh):
+        memory_kind = None
 
     def rule(x) -> NamedSharding:
         shape = getattr(x, "shape", ())
@@ -104,7 +108,7 @@ def supports_host_offload(mesh: Mesh) -> bool:
 
 
 def _named_sharding(mesh: Mesh, spec: PartitionSpec, memory_kind: Optional[str]) -> NamedSharding:
-    if memory_kind is None or not supports_host_offload(mesh):
+    if memory_kind is None:
         return NamedSharding(mesh, spec)
     return NamedSharding(mesh, spec, memory_kind=memory_kind)
 

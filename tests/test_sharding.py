@@ -96,6 +96,14 @@ class TestHybridMesh:
         mesh = build_mesh({"dp": 2, "fsdp": 4}, dcn_axes={"dp": 2})
         assert dict(mesh.shape) == {"dp": 2, "fsdp": 4}
 
+    def test_hybrid_mesh_rejects_non_dividing_dcn(self):
+        with pytest.raises(ValueError, match="must divide"):
+            build_mesh({"dp": 2, "fsdp": 4}, dcn_axes={"dp": 4})
+
+    def test_hybrid_mesh_rejects_unknown_dcn_axis(self):
+        with pytest.raises(ValueError, match="not present"):
+            build_mesh({"dp": 2, "fsdp": 4}, dcn_axes={"pp": 2})
+
     def test_offload_not_supported_on_cpu(self):
         mesh = build_mesh({"dp": 8})
         assert not supports_host_offload(mesh)
