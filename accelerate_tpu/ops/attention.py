@@ -47,8 +47,9 @@ def dot_product_attention(
         return flash_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
     if implementation == "ring":
         raise ValueError(
-            "ring attention runs inside shard_map over the `sp` axis; call "
-            "accelerate_tpu.parallel.ring_attention.ring_attention instead"
+            "ring attention runs over the `sp` mesh axis; call "
+            "accelerate_tpu.parallel.ring_attention_sharded(q, k, v, mesh) on global "
+            "arrays, or ring_attention(...) on local shards inside shard_map"
         )
 
     # XLA path: grouped-query handled by repeating kv heads.

@@ -1,0 +1,179 @@
+"""Ring attention — sequence/context parallelism over an ``sp`` mesh axis.
+
+Net-new capability vs the reference (SURVEY.md §5.7: no ring attention, Ulysses
+or context-parallel groups exist anywhere in its tree; its only sequence-parallel
+surface is a Megatron passthrough flag, ``utils/dataclasses.py:1323``).
+
+Design: the sequence dimension is sharded contiguously over ``sp``.  Each step of
+an ``lax.scan`` rotates the local kv shard one hop around the ring with
+``lax.ppermute`` while accumulating blockwise attention with the online-softmax
+recurrence (m/l/acc in fp32).  Only the local ``[S/sp, S/sp]`` score tile ever
+materializes, giving O(S/sp) activation memory for arbitrarily long sequences,
+and the kv rotation overlaps with compute in XLA's schedule (the ppermute for
+step t+1 is independent of step t's einsums).
+
+The whole computation is plain differentiable JAX (``ppermute`` has a transpose
+rule), so the backward pass — itself a ring — comes from autodiff; pass
+``remat=True`` to recompute per-step tiles instead of storing them.
+
+Entry points:
+  - :func:`ring_attention` — call INSIDE ``shard_map`` on local shards.
+  - :func:`ring_attention_sharded` — convenience wrapper that shard_maps over a
+    mesh for global BSHD arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _chunk_attention(q, k, v, q_offset, k_offset, causal, scale, seg_q, seg_k, rep):
+    """Blockwise scores for one (q-chunk, kv-chunk) pair with global-position masking.
+
+    q: [B, Sl, H, D]; k/v: [B, Sl, Hkv, D] — GQA heads repeat here, per chunk, so
+    the ring rotation itself only moves the small Hkv shards.
+    Returns (m, l, pv): rowmax [B, H, Sl, 1], rowsum [B, H, Sl, 1], p@v [B, H, Sl, D].
+    """
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    sl_q, sl_k = q.shape[1], k.shape[1]
+    mask = None
+    if causal:
+        rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sl_q, sl_k), 0)
+        cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sl_q, sl_k), 1)
+        mask = cols <= rows
+    if seg_q is not None:
+        seg_mask = seg_q[:, :, None] == seg_k[:, None, :]  # [B, Sl, Sl]
+        seg_mask = seg_mask[:, None]  # [B, 1, Sl, Sl]
+        mask = seg_mask if mask is None else jnp.logical_and(mask, seg_mask)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B, H, Sl, 1]
+    # Rows that are fully masked this step keep m = -inf-ish; exp underflows to 0.
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return m, l, pv
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    remat: bool = False,
+) -> jax.Array:
+    """Ring attention on LOCAL sequence shards (must run inside ``shard_map``).
+
+    Args are BSHD shards ``[B, S/sp, H, D]``; ``segment_ids`` is the local
+    ``[B, S/sp]`` shard.  GQA supported (kv heads divide q heads).  Returns the
+    local output shard ``[B, S/sp, H, D]``.
+    """
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    batch, sl, n_heads, head_dim = q.shape
+    rep = n_heads // k.shape[2]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_offset = idx * sl
+
+    def accumulate(stats, k_cur, v_cur, seg_cur, t):
+        m_prev, l_prev, acc = stats
+        src = (idx - t) % n  # ring owner of the current kv chunk
+        m_cur, l_cur, pv = _chunk_attention(
+            q, k_cur, v_cur, q_offset, src * sl, causal, scale,
+            segment_ids, seg_cur, rep,
+        )
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha_prev = jnp.exp(m_prev - m_new)
+        alpha_cur = jnp.exp(m_cur - m_new)
+        l_new = alpha_prev * l_prev + alpha_cur * l_cur
+        acc = acc * alpha_prev + pv * alpha_cur
+        return (m_new, l_new, acc)
+
+    def step(carry, t):
+        k_cur, v_cur, seg_cur, stats = carry
+        stats = accumulate(stats, k_cur, v_cur, seg_cur, t)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        seg_nxt = (
+            jax.lax.ppermute(seg_cur, axis_name, perm) if seg_cur is not None else None
+        )
+        return (k_nxt, v_nxt, seg_nxt, stats), None
+
+    if remat:
+        step = jax.checkpoint(step)
+
+    m0 = jnp.full((batch, n_heads, sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, n_heads, sl, 1), jnp.float32)
+    acc0 = jnp.zeros((batch, n_heads, sl, head_dim), jnp.float32)
+    carry = (k, v, segment_ids, (m0, l0, acc0))
+    if n > 1:
+        # n-1 rotated steps; the final chunk is consumed outside the scan so the
+        # last (useless) ring hop is never emitted.
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(n - 1))
+    k_last, v_last, seg_last, stats = carry
+    m, l, acc = accumulate(stats, k_last, v_last, seg_last, n - 1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)  # [B, H, Sl, D]
+    return jnp.swapaxes(out, 1, 2)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    batch_axes=("dp", "fsdp"),
+    remat: bool = False,
+) -> jax.Array:
+    """Shard_map :func:`ring_attention` over global BSHD arrays.
+
+    Sequence (dim 1) shards over ``axis_name``; batch shards over whichever of
+    ``batch_axes`` are present in the mesh.  Other dims replicate.
+    """
+    b_axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    b_spec = b_axes if b_axes else None
+    qkv_spec = PartitionSpec(b_spec, axis_name, None, None)
+    seg_spec = PartitionSpec(b_spec, axis_name)
+
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, scale=scale, remat=remat
+    )
+    if segment_ids is not None:
+        wrapped = jax.shard_map(
+            lambda q, k, v, s: fn(q, k, v, segment_ids=s),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return wrapped(q, k, v, segment_ids)
+    wrapped = jax.shard_map(
+        lambda q, k, v: fn(q, k, v),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return wrapped(q, k, v)
