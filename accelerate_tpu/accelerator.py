@@ -449,21 +449,31 @@ class Accelerator:
         return self._place_with_offload(init_fn, params, shardings)
 
     def _train_state_shardings(self, abstract_state):
-        param_rule = make_param_sharding_fn(self.mesh, self.effective_fsdp_plugin)
-        opt_rule = make_opt_sharding_fn(self.mesh, self.effective_fsdp_plugin)
+        plugin = self.effective_fsdp_plugin
+        tp_parallel = mesh_lib.mesh_axis_size(self.mesh, "tp") > 1
+        if tp_parallel:
+            from .parallel.tensor_parallel import make_tp_sharding_fn
+
+            param_rule = make_tp_sharding_fn(self.mesh, plugin)
+            opt_rule = make_tp_sharding_fn(self.mesh, plugin, for_opt_state=True)
+        else:
+            shape_param_rule = make_param_sharding_fn(self.mesh, plugin)
+            shape_opt_rule = make_opt_sharding_fn(self.mesh, plugin)
+            param_rule = lambda path, x: shape_param_rule(x)
+            opt_rule = lambda path, x: shape_opt_rule(x)
         replicated = NamedSharding(self.mesh, PartitionSpec())
 
         def rule(path, x):
             root = path[0]
             name = getattr(root, "name", getattr(root, "key", None))
             if name == "params":
-                return param_rule(x)
+                return param_rule(path, x)
             if name == "opt_state":
-                return opt_rule(x)
+                return opt_rule(path, x)
             if name == "grad_accum":
                 # grads are touched every micro-step: keep them in HBM even when
                 # the optimizer state is host-offloaded
-                return _strip_memory_kind(opt_rule(x))
+                return _strip_memory_kind(opt_rule(path, x))
             return replicated
 
         return jax.tree_util.tree_map_with_path(rule, abstract_state)

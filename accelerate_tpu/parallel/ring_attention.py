@@ -16,6 +16,11 @@ The whole computation is plain differentiable JAX (``ppermute`` has a transpose
 rule), so the backward pass — itself a ring — comes from autodiff; pass
 ``remat=True`` to recompute per-step tiles instead of storing them.
 
+Known inefficiency: with ``causal=True`` and a contiguous sequence layout,
+chunks entirely in the future still compute their (all-masked, zeroed) score
+tile, wasting ~half the attention FLOPs at large sp.  A zig-zag/striped
+sequence layout balances this; planned as a follow-up.
+
 Entry points:
   - :func:`ring_attention` — call INSIDE ``shard_map`` on local shards.
   - :func:`ring_attention_sharded` — convenience wrapper that shard_maps over a
@@ -59,7 +64,11 @@ def _chunk_attention(q, k, v, q_offset, k_offset, causal, scale, seg_q, seg_k, r
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # [B, H, Sl, 1]
-    # Rows that are fully masked this step keep m = -inf-ish; exp underflows to 0.
+    # Fully-masked rows produce p = exp(NEG_INF - NEG_INF) = 1 and garbage l/pv
+    # HERE; correctness relies on step t=0 processing the local diagonal chunk
+    # (so m_prev is finite afterwards) which makes accumulate()'s
+    # alpha_cur = exp(NEG_INF - m_prev) flush later all-masked chunks to zero.
+    # Do not reorder the ring schedule without revisiting this.
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
@@ -144,14 +153,19 @@ def ring_attention_sharded(
     causal: bool = True,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
-    batch_axes=("dp", "fsdp"),
+    batch_axes=None,
     remat: bool = False,
 ) -> jax.Array:
     """Shard_map :func:`ring_attention` over global BSHD arrays.
 
     Sequence (dim 1) shards over ``axis_name``; batch shards over whichever of
-    ``batch_axes`` are present in the mesh.  Other dims replicate.
+    ``batch_axes`` (default: the framework's ``DATA_AXES`` convention) are
+    present in the mesh.  Other dims replicate.
     """
+    from .mesh import DATA_AXES
+
+    if batch_axes is None:
+        batch_axes = DATA_AXES
     b_axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
     b_spec = b_axes if b_axes else None
     qkv_spec = PartitionSpec(b_spec, axis_name, None, None)
