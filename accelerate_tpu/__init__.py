@@ -23,6 +23,7 @@ from .data_loader import (
     prepare_data_loader,
     skip_first_batches,
 )
+from .local_sgd import LocalSGD
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
@@ -37,5 +38,7 @@ from .utils import (
     PrecisionPolicy,
     ProjectConfiguration,
     ZeroPlugin,
+    find_executable_batch_size,
+    release_memory,
 )
 from .utils.random import set_seed

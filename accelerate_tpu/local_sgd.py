@@ -1,0 +1,179 @@
+"""Local SGD — periodic parameter averaging instead of per-step gradient sync.
+
+Reference: ``local_sgd.py:19-102`` — a context manager that enters DDP
+``no_sync`` so gradients stay local, then every ``local_sgd_steps`` steps
+averages the model parameters across processes with ``reduce(mean)``.
+
+TPU-native design: "unsynchronized replicas" cannot be expressed by skipping a
+collective inside one pjit-compiled step (XLA inserts the gradient ``psum``
+automatically for a ``dp``-sharded batch).  Instead the replica dimension is
+made explicit: parameters and optimizer state gain a leading axis of size
+``dp`` sharded over the ``dp`` mesh axis, local steps run as a ``jax.vmap`` of
+the per-replica update — which XLA compiles with *zero* cross-replica
+collectives, the whole point of Local SGD — and the periodic sync is a mean
+over that axis (one all-reduce every K steps instead of every step).
+
+Usage::
+
+    with LocalSGD(accelerator, state, loss_fn, local_sgd_steps=8) as local:
+        for batch in dataloader:
+            metrics = local.step(batch)        # batch: global batch, leading dim
+    state = local.final_state                  # averaged TrainState
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .train_state import TrainState
+
+
+def _mean_preserve_dtype(x):
+    return jnp.mean(x.astype(jnp.float32), axis=0).astype(x.dtype)
+
+
+class LocalSGD:
+    def __init__(
+        self,
+        accelerator,
+        state: TrainState,
+        loss_fn: Callable,
+        local_sgd_steps: int = 8,
+        enabled: bool = True,
+        replica_axis: str = "dp",
+    ):
+        if local_sgd_steps < 1:
+            raise ValueError("local_sgd_steps must be >= 1")
+        self.accelerator = accelerator
+        self.enabled = enabled
+        self.local_sgd_steps = local_sgd_steps
+        self.replica_axis = replica_axis
+        self._state = state
+        self._loss_fn = loss_fn
+        self._step_count = 0
+        self.final_state: Optional[TrainState] = None
+        mesh = accelerator.mesh
+        if enabled and (mesh is None or replica_axis not in mesh.shape):
+            raise ValueError(
+                f"LocalSGD needs a mesh with a '{replica_axis}' axis; got {mesh}."
+            )
+        # enabled=False degrades to a single synced replica (reference
+        # ``local_sgd.py:63-66``: disabled LocalSGD is a no-op pass-through),
+        # so the same loop body works with the flag off.
+        self.num_replicas = int(mesh.shape[replica_axis]) if enabled else 1
+        # Decide loss_fn arity once (2-arg: (params, batch); 3-arg adds rng).
+        try:
+            n_args = len(inspect.signature(loss_fn).parameters)
+        except (TypeError, ValueError):
+            n_args = 3
+        self._loss_takes_rng = n_args >= 3
+
+    # -- replica stacking ---------------------------------------------------
+
+    def _replica_sharding(self, template):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self.accelerator.mesh
+        spec = PartitionSpec(self.replica_axis)
+        return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, spec), template)
+
+    def _place(self, tree):
+        if not self.enabled:
+            return tree  # single replica: leave placement to XLA
+        return jax.device_put(tree, self._replica_sharding(tree))
+
+    def _stack(self, tree):
+        n = self.num_replicas
+
+        def tile(x):
+            x = jnp.asarray(x)
+            return jnp.broadcast_to(x[None], (n,) + x.shape)
+
+        return self._place(jax.tree_util.tree_map(tile, tree))
+
+    def __enter__(self) -> "LocalSGD":
+        state = self._state
+        self._params = self._stack(state.params)
+        self._opt_state = self._stack(state.opt_state)
+        n = self.num_replicas
+        tx = state.tx
+        loss_fn = self._loss_fn
+
+        takes_rng = self._loss_takes_rng
+
+        def one_replica(params, opt_state, batch, rng):
+            def scalar_loss(p):
+                if takes_rng:
+                    return loss_fn(p, batch, rng)
+                return loss_fn(p, batch)
+
+            loss, grads = jax.value_and_grad(scalar_loss)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        # vmap over the replica axis: no collectives between replicas.
+        self._local_step = jax.jit(jax.vmap(one_replica))
+
+        def sync(params):
+            avg = jax.tree_util.tree_map(_mean_preserve_dtype, params)
+            return jax.tree_util.tree_map(
+                lambda a, x: jnp.broadcast_to(a[None], x.shape).astype(x.dtype), avg, params
+            )
+
+        self._sync = jax.jit(sync)
+        self._rng = state.rng
+        self._n = n
+        return self
+
+    # -- stepping -----------------------------------------------------------
+
+    def step(self, batch: Any):
+        """Run one local step on every replica; sync params every K steps.
+
+        ``batch`` is the global batch (leading dim divisible by the number of
+        replicas); it is folded to ``(replicas, per_replica, ...)``.  When
+        ``enabled=False`` there is one replica and every step is synced —
+        i.e. plain data-parallel training with the same loop body.
+        """
+        n = self._n
+
+        def fold(x):
+            x = jnp.asarray(x)
+            if x.shape[0] % n:
+                raise ValueError(
+                    f"Global batch dim {x.shape[0]} not divisible by {n} replicas."
+                )
+            return x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+        folded = self._place(jax.tree_util.tree_map(fold, batch))
+        if self._rng is not None:
+            self._rng, sub = jax.random.split(self._rng)
+            rngs = jax.random.split(sub, n)
+        else:
+            rngs = jnp.zeros((n, 2), dtype=jnp.uint32)
+        self._params, self._opt_state, losses = self._local_step(
+            self._params, self._opt_state, folded, rngs
+        )
+        self._step_count += 1
+        if self._step_count % self.local_sgd_steps == 0:
+            self._params = self._sync(self._params)
+        return {"loss": jnp.mean(losses), "losses": losses}
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        # Final average (reference ``local_sgd.py:99-102`` syncs on exit).
+        self._params = self._sync(self._params)
+        params = jax.tree_util.tree_map(lambda x: x[0], self._params)
+        opt_state = jax.tree_util.tree_map(lambda x: x[0], self._opt_state)
+        self.final_state = self._state.replace(
+            params=params,
+            opt_state=opt_state,
+            step=self._state.step + self._step_count,
+            rng=self._rng,
+        )
+        return False

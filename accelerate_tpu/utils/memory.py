@@ -1,0 +1,140 @@
+"""OOM recovery utilities (reference ``utils/memory.py:29-158``).
+
+The reference catches CUDA/XPU out-of-memory errors by string-matching the
+exception (``should_reduce_batch_size``, ``utils/memory.py:69-84``) and reruns
+the decorated training function with a halved batch size
+(``find_executable_batch_size``, ``utils/memory.py:87-155``).  On TPU the
+analogous failure is an XLA ``RESOURCE_EXHAUSTED`` error raised at compile or
+execution time; we match that (plus host ``MemoryError``) and additionally clear
+JAX's compilation cache between attempts so stale executables for the failed
+batch size don't pin HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+# Substrings identifying an out-of-memory condition in XLA/JAX error text.
+# XLA raises ``XlaRuntimeError: RESOURCE_EXHAUSTED: Out of memory allocating
+# ... bytes`` on HBM exhaustion; pjrt sometimes phrases it as "Resource
+# exhausted"; host allocations raise MemoryError.
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "Out of memory",
+    "out of memory",
+    "Failed to allocate",
+)
+
+
+def release_memory(*objects):
+    """Delete device buffers and collect garbage (reference ``utils/memory.py:29-66``).
+
+    JAX arrays hold HBM until the Python reference dies *and* the buffer is
+    deleted; ``jax.Array.delete()`` frees eagerly.  Returns a ``None`` for every
+    input so callers can rebind: ``a, b = release_memory(a, b)``.
+    """
+    import jax
+
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        obj = objects[i]
+        for leaf in jax.tree_util.tree_leaves(obj):
+            if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+                leaf.delete()
+        objects[i] = None
+    gc.collect()
+    return objects
+
+
+def should_reduce_batch_size(exception: BaseException) -> bool:
+    """True if ``exception`` signals device/host memory exhaustion
+    (reference ``utils/memory.py:69-84``, adapted to XLA error shapes)."""
+    if isinstance(exception, MemoryError):
+        return True
+    text = str(exception)
+    return any(marker in text for marker in _OOM_MARKERS)
+
+
+def clear_device_cache(garbage_collection: bool = True) -> None:
+    """Drop cached compiled executables + run GC.
+
+    The closest TPU analog of ``torch.cuda.empty_cache``: XLA frees HBM when
+    buffers are deleted, but live compiled executables keep their scratch
+    reservations, so failed-size executables must be evicted before a retry.
+    """
+    import jax
+
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    if garbage_collection:
+        gc.collect()
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+    reduce_batch_size_fn: Optional[Callable[[int], int]] = None,
+):
+    """Decorator: retry ``function(batch_size, ...)`` with a smaller batch size
+    on OOM (reference ``utils/memory.py:87-155``).
+
+    The wrapped function must take ``batch_size`` as its first argument.  Each
+    OOM halves the batch size (or applies ``reduce_batch_size_fn``) until the
+    function succeeds or the batch size reaches zero.
+
+    Example::
+
+        @find_executable_batch_size(starting_batch_size=1024)
+        def train(batch_size):
+            step = accelerator.compile_train_step(loss_fn)
+            ...
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+
+    reduce_fn = reduce_batch_size_fn or (lambda b: b // 2)
+    state = {"batch_size": starting_batch_size}
+
+    params = list(inspect.signature(function).parameters.keys())
+    is_method = bool(params) and params[0] == "self"
+    if not params or (is_method and len(params) < 2):
+        raise TypeError(
+            f"Batch size was passed into `{function.__name__}` as the first argument, "
+            "but it did not accept one."
+        )
+
+    @functools.wraps(function)
+    def decorator(*args, **kwargs):
+        state["batch_size"] = starting_batch_size
+        clear_device_cache(garbage_collection=False)
+        while True:
+            if state["batch_size"] <= 0:
+                raise RuntimeError(
+                    "No executable batch size found, reached zero. "
+                    "The model does not fit on this device even with batch size 1."
+                )
+            if is_method:
+                call_args = (args[0], state["batch_size"], *args[1:])
+            else:
+                call_args = (state["batch_size"], *args)
+            try:
+                return function(*call_args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - mirror reference's broad catch
+                if should_reduce_batch_size(e):
+                    clear_device_cache()
+                    state["batch_size"] = reduce_fn(state["batch_size"])
+                else:
+                    raise
+
+    return decorator

@@ -1,0 +1,211 @@
+"""Tests for OOM recovery (reference tests/test_memory_utils.py) and LocalSGD
+(reference local_sgd.py semantics: local steps don't sync, every K-th does)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, LocalSGD, TrainState, find_executable_batch_size, release_memory
+from accelerate_tpu.utils.memory import should_reduce_batch_size
+
+
+class FakeOOM(RuntimeError):
+    pass
+
+
+class TestShouldReduceBatchSize:
+    def test_xla_resource_exhausted(self):
+        assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 12345 bytes"))
+
+    def test_memory_error(self):
+        assert should_reduce_batch_size(MemoryError())
+
+    def test_other_error(self):
+        assert not should_reduce_batch_size(ValueError("bad shape"))
+
+
+class TestFindExecutableBatchSize:
+    def test_halves_until_fit(self):
+        tried = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def run(batch_size):
+            tried.append(batch_size)
+            if batch_size > 16:
+                raise FakeOOM("RESOURCE_EXHAUSTED: Out of memory")
+            return batch_size
+
+        assert run() == 16
+        assert tried == [128, 64, 32, 16]
+
+    def test_non_oom_propagates(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def run(batch_size):
+            raise ValueError("not an oom")
+
+        with pytest.raises(ValueError):
+            run()
+
+    def test_exhausts_to_zero(self):
+        @find_executable_batch_size(starting_batch_size=4)
+        def run(batch_size):
+            raise FakeOOM("RESOURCE_EXHAUSTED: Out of memory")
+
+        with pytest.raises(RuntimeError, match="reached zero"):
+            run()
+
+    def test_bare_oom_substring_not_matched(self):
+        # "BLOOM"-style false positives must propagate (review finding)
+        @find_executable_batch_size(starting_batch_size=8)
+        def run(batch_size):
+            raise FileNotFoundError("BLOOM-560m checkpoint not found")
+
+        with pytest.raises(FileNotFoundError):
+            run()
+
+    def test_decorating_a_method(self):
+        class Trainer:
+            def __init__(self):
+                self.tried = []
+
+            @find_executable_batch_size(starting_batch_size=32)
+            def run(self, batch_size, extra=0):
+                self.tried.append(batch_size)
+                if batch_size > 8:
+                    raise FakeOOM("RESOURCE_EXHAUSTED")
+                return batch_size + extra
+
+        t = Trainer()
+        assert t.run(extra=100) == 108
+        assert t.tried == [32, 16, 8]
+
+    def test_zero_arg_function_rejected(self):
+        with pytest.raises(TypeError):
+            @find_executable_batch_size(starting_batch_size=8)
+            def run():
+                pass
+
+    def test_passes_extra_args(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def run(batch_size, a, b=1):
+            return batch_size + a + b
+
+        assert run(10, b=2) == 20
+
+    def test_resets_between_calls(self):
+        calls = {"n": 0}
+
+        @find_executable_batch_size(starting_batch_size=16)
+        def run(batch_size):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise FakeOOM("RESOURCE_EXHAUSTED: Out of memory")
+            return batch_size
+
+        assert run() == 8
+        # second invocation starts from 16 again
+        assert run() == 16
+
+
+class TestReleaseMemory:
+    def test_returns_nones(self):
+        a = jnp.ones((4,))
+        b = {"x": jnp.zeros((2,))}
+        a, b = release_memory(a, b)
+        assert a is None and b is None
+
+
+def _quadratic_loss(params, batch):
+    pred = batch["x"] * params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _make_batch(n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    return {"x": x, "y": 3.0 * x}
+
+
+class TestLocalSGD:
+    def _setup(self, lr=0.2):
+        acc = Accelerator(mesh={"dp": -1})
+        params = {"w": jnp.zeros((1,))}
+        state = TrainState.create(params=params, tx=optax.sgd(lr))
+        return acc, state
+
+    def test_converges(self):
+        acc, state = self._setup()
+        with LocalSGD(acc, state, _quadratic_loss, local_sgd_steps=4) as local:
+            for i in range(24):
+                metrics = local.step(_make_batch(16, i))
+        final = local.final_state
+        assert final is not None
+        np.testing.assert_allclose(np.asarray(final.params["w"]), [3.0], atol=0.05)
+        assert int(final.step) == int(state.step) + 24
+
+    def test_replicas_equal_after_sync(self):
+        acc, state = self._setup()
+        with LocalSGD(acc, state, _quadratic_loss, local_sgd_steps=2) as local:
+            local.step(_make_batch(16, 0))
+            # after 1 step replicas have seen different shards → may differ
+            local.step(_make_batch(16, 1))
+            # sync happened at step 2
+            stacked = np.asarray(local._params["w"])
+            for r in range(1, local.num_replicas):
+                np.testing.assert_allclose(stacked[r], stacked[0], rtol=1e-6)
+
+    def test_k1_matches_synced_sgd(self):
+        # K=1: average-after-every-step == plain data-parallel SGD on the full batch
+        acc, state = self._setup(lr=0.1)
+        batches = [_make_batch(16, i) for i in range(6)]
+        with LocalSGD(acc, state, _quadratic_loss, local_sgd_steps=1) as local:
+            for b in batches:
+                local.step(b)
+        w_local = np.asarray(local.final_state.params["w"])
+
+        # reference: same SGD on per-replica shards, averaged each step
+        n = local.num_replicas
+        w = np.zeros((n, 1), dtype=np.float32)
+        for b in batches:
+            xs = b["x"].reshape(n, -1, 1)
+            ys = b["y"].reshape(n, -1, 1)
+            grads = np.stack(
+                [np.mean(2 * (xs[r] * w[r] - ys[r]) * xs[r], axis=0) for r in range(n)]
+            )
+            w = w - 0.1 * grads
+            w[:] = w.mean(axis=0)
+        np.testing.assert_allclose(w_local, w[0], rtol=1e-4, atol=1e-5)
+
+    def test_batch_not_divisible_raises(self):
+        acc, state = self._setup()
+        with LocalSGD(acc, state, _quadratic_loss, local_sgd_steps=2) as local:
+            with pytest.raises(ValueError, match="not divisible"):
+                local.step(_make_batch(9, 0))
+
+    def test_disabled_is_passthrough(self):
+        # enabled=False: same loop body, single synced replica (reference
+        # local_sgd.py:63-66 no-op semantics)
+        acc, state = self._setup()
+        with LocalSGD(acc, state, _quadratic_loss, enabled=False) as local:
+            assert local.num_replicas == 1
+            for i in range(20):
+                local.step(_make_batch(16, i))
+        final = local.final_state
+        assert final is not None
+        np.testing.assert_allclose(np.asarray(final.params["w"]), [3.0], atol=0.05)
+
+    def test_rng_loss_fn_arity(self):
+        acc, state = self._setup()
+        seen = {"rng": False}
+
+        def loss_with_rng(params, batch, rng):
+            seen["rng"] = True
+            return _quadratic_loss(params, batch)
+
+        state = state.replace(rng=jax.random.PRNGKey(0))
+        local = LocalSGD(acc, state, loss_with_rng, local_sgd_steps=2)
+        with local:
+            local.step(_make_batch(16, 0))
+        assert seen["rng"]
