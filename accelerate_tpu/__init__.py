@@ -23,6 +23,7 @@ from .data_loader import (
     prepare_data_loader,
     skip_first_batches,
 )
+from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler

@@ -1,0 +1,5 @@
+"""``python -m accelerate_tpu`` → the CLI (reference console script `accelerate`)."""
+
+from accelerate_tpu.commands.accelerate_cli import main
+
+main()

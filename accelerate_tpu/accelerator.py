@@ -199,9 +199,48 @@ class Accelerator:
 
     # --------------------------------------------------------------- topology
     def _default_mesh(self):
-        """Derive the mesh from plugins: fsdp axis and/or tp/pp/sp/ep axes, rest dp."""
+        """Derive the mesh from env (launcher) or plugins: fsdp/tp/pp/sp/ep axes, rest dp."""
         ps = self.state.partial_state
         n = ps.num_devices
+        # `accelerate-tpu launch --mesh` serializes the layout to ACCELERATE_MESH
+        # (commands/launch.py prepare_launch_env), the mesh analog of the
+        # reference's ACCELERATE_*/FSDP_* env IPC (utils/launch.py:152-273).
+        env_mesh = os.environ.get("ACCELERATE_MESH")
+        if env_mesh:
+            from .utils.dataclasses import parse_mesh_spec
+
+            axes = parse_mesh_spec(env_mesh)
+            # An explicit mesh must still carry the axes the active plugins
+            # shard over — otherwise FSDP/TP would silently degrade to
+            # replication (mesh_axis_size returns 1 for missing axes).
+            required = []
+            fsdp_plugin = self.effective_fsdp_plugin
+            if fsdp_plugin is not None and fsdp_plugin.shards_opt_state:
+                required.append("fsdp")
+            mp = self.state.model_parallel_plugin
+            if mp is not None:
+                for axis, degree in (
+                    ("tp", mp.tp_degree), ("pp", mp.pp_degree),
+                    ("sp", mp.sp_degree), ("ep", mp.expert_parallel_degree),
+                ):
+                    if degree > 1:
+                        required.append(axis)
+            missing = [a for a in required if a not in axes]
+            if missing:
+                raise ValueError(
+                    f"ACCELERATE_MESH={env_mesh!r} lacks axes {missing} required by the "
+                    "active FSDP/ZeRO/model-parallel plugins. Add them to --mesh "
+                    f"(e.g. --mesh {','.join(f'{a}=...' for a in missing)},{env_mesh}) "
+                    "or drop the plugin flags."
+                )
+            dcn_spec = os.environ.get("ACCELERATE_DCN_MESH")
+            ps.set_mesh(
+                MeshConfig(
+                    axes=axes,
+                    dcn_axes=parse_mesh_spec(dcn_spec) if dcn_spec else {},
+                )
+            )
+            return
         mp = self.state.model_parallel_plugin
         axes: Dict[str, int] = {}
         if mp is not None:

@@ -1,1 +1,78 @@
-"""config subpackage."""
+"""`accelerate-tpu config` — questionnaire → YAML (reference ``commands/config/``)."""
+
+from __future__ import annotations
+
+import argparse
+
+from .cluster import get_cluster_input
+from .config_args import (
+    ClusterConfig,
+    default_config_file,
+    default_yaml_config_file,
+    load_config_from_file,
+    parse_mesh_spec,
+)
+
+description = "Launches a series of prompts to create and save a default_config.yaml configuration file."
+
+
+def config_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config", description=description)
+    parser.add_argument(
+        "--config_file",
+        default=None,
+        help=(
+            "Where to save the config file. Defaults to "
+            "~/.cache/accelerate_tpu/default_config.yaml (override root with ATPU_HOME)."
+        ),
+    )
+    sub = parser.add_subparsers(dest="config_subcommand")
+    default_p = sub.add_parser("default", description="Write a default config without prompting.")
+    default_p.add_argument("--config_file", default=None)
+    default_p.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    default_p.add_argument("--mesh", default=None, help='e.g. "dp=-1" or "fsdp=4,tp=2"')
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser
+
+
+def _save_config(config: ClusterConfig, path: str) -> str:
+    if path.endswith(".json"):
+        config.to_json_file(path)
+    else:
+        config.to_yaml_file(path)
+    return path
+
+
+def write_default_config(config_file=None, mixed_precision="bf16", mesh=None) -> str:
+    """Non-interactive default (reference ``config default`` subcommand)."""
+    config = ClusterConfig(mixed_precision=mixed_precision, mesh=parse_mesh_spec(mesh) if mesh else {})
+    return _save_config(config, config_file or default_yaml_config_file)
+
+
+def config_command(args):
+    if getattr(args, "config_subcommand", None) == "default":
+        path = write_default_config(args.config_file, args.mixed_precision, args.mesh)
+    else:
+        path = _save_config(get_cluster_input(), args.config_file or default_config_file)
+    print(f"accelerate-tpu configuration saved at {path}")
+
+
+def main():
+    parser = config_command_parser()
+    args = parser.parse_args()
+    config_command(args)
+
+
+__all__ = [
+    "ClusterConfig",
+    "config_command",
+    "config_command_parser",
+    "default_config_file",
+    "load_config_from_file",
+    "parse_mesh_spec",
+    "write_default_config",
+]

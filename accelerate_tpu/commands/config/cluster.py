@@ -1,0 +1,100 @@
+"""Interactive questionnaire building a ClusterConfig (reference
+``commands/config/cluster.py:49`` ``get_cluster_input``).
+
+Kept deliberately plain (input()/EOF-safe) rather than porting the reference's
+curses-style menu (``commands/menu/``): the questionnaire must work over SSH to
+a pod worker and inside CI, where a TTY is not guaranteed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .config_args import ClusterConfig, ComputeEnvironment, parse_mesh_spec
+
+
+def _ask(prompt: str, default: str = "", convert: Optional[Callable] = None, choices: Optional[List[str]] = None):
+    suffix = f" [{default}]" if default != "" else ""
+    if choices:
+        prompt = f"{prompt} ({'/'.join(choices)})"
+    try:
+        raw = input(f"{prompt}{suffix}: ").strip()
+    except EOFError:
+        raw = ""
+    if raw == "":
+        raw = default
+    if choices and raw not in choices:
+        print(f"  invalid choice {raw!r}, using {default!r}")
+        raw = default
+    return convert(raw) if convert else raw
+
+
+def _ask_bool(prompt: str, default: bool = False) -> bool:
+    raw = _ask(prompt, "yes" if default else "no", choices=["yes", "no"])
+    return raw == "yes"
+
+
+def get_cluster_input() -> ClusterConfig:
+    num_machines = _ask("How many machines (hosts) will you use", "1", int)
+    machine_rank, ip, port = 0, None, None
+    if num_machines > 1:
+        machine_rank = _ask("What is the rank of this machine", "0", int)
+        ip = _ask("What is the IP address of the machine that will host the coordinator", "")
+        port = _ask("What port will the coordinator use", "8476", int)
+
+    use_cpu = _ask_bool("Run on CPU only (no TPU)", False)
+    mixed_precision = _ask("Mixed precision", "bf16" if not use_cpu else "no", choices=["no", "bf16", "fp16"])
+    debug = _ask_bool("Enable collective shape-checking debug mode", False)
+    grad_accum = _ask("Gradient accumulation steps", "1", int)
+
+    mesh = {}
+    mesh_spec = _ask('Mesh axes as "name=size,..." (-1 fills; empty = pure data parallel)', "")
+    if mesh_spec:
+        mesh = parse_mesh_spec(mesh_spec)
+
+    fsdp_config, zero_config, mp_config = {}, {}, {}
+    if _ask_bool("Use FSDP-style parameter sharding", False):
+        fsdp_config = {
+            "sharding_strategy": _ask(
+                "Sharding strategy", "FULL_SHARD",
+                choices=["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD", "HYBRID_SHARD_ZERO2"],
+            ),
+            "offload_params": _ask_bool("Offload parameters to host memory", False),
+            "min_num_params": _ask("Minimum parameter count for sharding a weight", "0", int),
+            "activation_checkpointing": _ask_bool("Enable activation checkpointing", False),
+        }
+    elif _ask_bool("Use ZeRO-style optimizer/parameter sharding", False):
+        zero_config = {
+            "zero_stage": _ask("ZeRO stage", "2", int, choices=["0", "1", "2", "3"]),
+            "offload_optimizer_device": _ask("Offload optimizer state to", "none", choices=["none", "cpu"]),
+            "offload_param_device": _ask("Offload parameters to", "none", choices=["none", "cpu"]),
+        }
+    if _ask_bool("Use tensor/pipeline model parallelism", False):
+        mp_config = {
+            "tp_degree": _ask("Tensor-parallel degree", "1", int),
+            "pp_degree": _ask("Pipeline-parallel degree", "1", int),
+            "sequence_parallelism": _ask_bool("Enable sequence parallelism", False),
+        }
+
+    compute_env = ComputeEnvironment.TPU_POD.value if num_machines > 1 else ComputeEnvironment.LOCAL_MACHINE.value
+    if use_cpu:
+        distributed_type = "MULTI_CPU" if num_machines > 1 else "NO"
+    else:
+        distributed_type = "MULTI_TPU" if num_machines > 1 else "TPU"
+
+    return ClusterConfig(
+        compute_environment=compute_env,
+        distributed_type=distributed_type,
+        num_machines=num_machines,
+        machine_rank=machine_rank,
+        main_process_ip=ip,
+        main_process_port=port,
+        mixed_precision=mixed_precision,
+        use_cpu=use_cpu,
+        debug=debug,
+        gradient_accumulation_steps=grad_accum,
+        mesh=mesh,
+        fsdp_config=fsdp_config,
+        zero_config=zero_config,
+        model_parallel_config=mp_config,
+    )

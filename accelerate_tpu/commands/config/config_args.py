@@ -1,0 +1,137 @@
+"""Config dataclasses + YAML/JSON persistence (reference
+``commands/config/config_args.py:43-267``).
+
+The reference stores a questionnaire result at
+``~/.cache/huggingface/accelerate/default_config.yaml`` and merges it with
+``accelerate launch`` flags.  Same design here, TPU-shaped: the config captures
+the JAX multi-controller topology (one process per host, coordinator
+rendezvous) and the mesh axis layout instead of torch.distributed ranks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from enum import Enum
+from pathlib import Path
+from typing import Dict, Optional
+
+import yaml
+
+hf_cache_home = os.path.expanduser(
+    os.environ.get("ATPU_HOME", os.path.join(os.environ.get("XDG_CACHE_HOME", "~/.cache"), "accelerate_tpu"))
+)
+cache_dir = os.path.join(hf_cache_home)
+default_json_config_file = os.path.join(cache_dir, "default_config.json")
+default_yaml_config_file = os.path.join(cache_dir, "default_config.yaml")
+
+# YAML is the default format, as in the reference (config_args.py:32-40).
+default_config_file = default_yaml_config_file
+
+
+def load_config_from_file(config_file: Optional[str] = None) -> "ClusterConfig":
+    if config_file is not None:
+        if not os.path.isfile(config_file):
+            raise FileNotFoundError(
+                f"The passed configuration file `{config_file}` does not exist. "
+                "Please pass an existing file to `accelerate-tpu launch`, or create one with "
+                "`accelerate-tpu config`."
+            )
+        config_file_to_load = config_file
+    else:
+        if os.path.isfile(default_yaml_config_file):
+            config_file_to_load = default_yaml_config_file
+        elif os.path.isfile(default_json_config_file):
+            config_file_to_load = default_json_config_file
+        else:
+            raise FileNotFoundError(
+                "No config file found. Run `accelerate-tpu config` first, or pass --config_file."
+            )
+    if config_file_to_load.endswith(".json"):
+        return ClusterConfig.from_json_file(config_file_to_load)
+    return ClusterConfig.from_yaml_file(config_file_to_load)
+
+
+class ComputeEnvironment(str, Enum):
+    LOCAL_MACHINE = "LOCAL_MACHINE"
+    TPU_POD = "TPU_POD"
+
+
+@dataclass
+class ClusterConfig:
+    """The launch topology + plugin defaults written by ``accelerate-tpu config``.
+
+    Reference ``ClusterConfig`` (``commands/config/config_args.py:175-227``)
+    carries torch.distributed fields (num_processes, gpu_ids, rdzv_backend...).
+    The TPU-native analog: ``num_machines`` JAX processes — one per host — each
+    seeing all local chips, rendezvousing at ``main_process_ip:port``; parallelism
+    is a mesh-axes dict, not a backend enum.
+    """
+
+    compute_environment: str = ComputeEnvironment.LOCAL_MACHINE.value
+    distributed_type: str = "TPU"          # TPU | MULTI_TPU | MULTI_CPU | NO
+    num_machines: int = 1                  # = number of JAX processes (hosts)
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    mixed_precision: str = "no"            # no | bf16 | fp16
+    use_cpu: bool = False
+    debug: bool = False                    # ACCELERATE_DEBUG_MODE collective checks
+    gradient_accumulation_steps: int = 1
+    # Mesh layout, e.g. {"dp": -1, "fsdp": 1, "tp": 1}; -1 = fill remaining devices.
+    mesh: Dict[str, int] = field(default_factory=dict)
+    dcn_mesh: Dict[str, int] = field(default_factory=dict)
+    # Plugin config blocks (hydrated into env vars by the launcher).
+    fsdp_config: Dict = field(default_factory=dict)
+    zero_config: Dict = field(default_factory=dict)
+    model_parallel_config: Dict = field(default_factory=dict)
+    # TPU pod metadata (for `accelerate-tpu tpu-config` SSH fan-out).
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+    tpu_use_sudo: bool = False
+    commands: Optional[list] = None
+    command_file: Optional[str] = None
+
+    def to_dict(self) -> Dict:
+        result = asdict(self)
+        # prune Nones for a tidy file, as the reference does (config_args.py:85-95)
+        return {k: v for k, v in result.items() if v is not None}
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "ClusterConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        extra = {k: v for k, v in data.items() if k not in known}
+        if extra:
+            raise ValueError(
+                f"Unknown keys in config file: {sorted(extra)}. "
+                f"Valid keys: {sorted(known)}"
+            )
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+    # -- io -----------------------------------------------------------------
+    @classmethod
+    def from_yaml_file(cls, yaml_file: str) -> "ClusterConfig":
+        with open(yaml_file, encoding="utf-8") as f:
+            data = yaml.safe_load(f) or {}
+        return cls.from_dict(data)
+
+    def to_yaml_file(self, yaml_file: str) -> None:
+        Path(yaml_file).parent.mkdir(parents=True, exist_ok=True)
+        with open(yaml_file, "w", encoding="utf-8") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=True)
+
+    @classmethod
+    def from_json_file(cls, json_file: str) -> "ClusterConfig":
+        with open(json_file, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def to_json_file(self, json_file: str) -> None:
+        Path(json_file).parent.mkdir(parents=True, exist_ok=True)
+        with open(json_file, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+
+# Re-exported for the CLI; the implementation lives in utils (the runtime also
+# parses ACCELERATE_MESH and must not depend on the commands tree).
+from ...utils.dataclasses import parse_mesh_spec  # noqa: E402  (re-export)

@@ -1,0 +1,88 @@
+"""`accelerate-tpu env` — platform diagnostic (reference ``commands/env.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+description = "Print the environment information (for bug reports)."
+
+
+def env_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu env", description=description)
+    parser.add_argument("--config_file", default=None)
+    if subparsers is not None:
+        parser.set_defaults(func=env_command)
+    return parser
+
+
+def gather_env_info(config_file=None) -> dict:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "`accelerate_tpu` version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "JAX version": jax.__version__,
+        "Backend platform": None,
+        "Device count": None,
+        "Process count": None,
+    }
+    try:
+        devices = jax.devices()
+        info["Backend platform"] = devices[0].platform
+        info["Device count"] = len(devices)
+        info["Process count"] = jax.process_count()
+    except Exception as e:  # backend init can fail on misconfigured hosts
+        info["Backend platform"] = f"unavailable ({e})"
+    try:
+        import flax
+
+        info["Flax version"] = flax.__version__
+    except ImportError:
+        pass
+    try:
+        import optax
+
+        info["Optax version"] = optax.__version__
+    except ImportError:
+        pass
+    from .config.config_args import default_config_file
+
+    path = config_file or default_config_file
+    if os.path.isfile(path):
+        from .config.config_args import load_config_from_file
+
+        info["Config file"] = path
+        info["Config"] = load_config_from_file(path).to_dict()
+    else:
+        info["Config file"] = f"not found ({path})"
+    env_keys = sorted(k for k in os.environ if k.startswith(("ACCELERATE_", "FSDP_", "MEGATRON_LM_", "JAX_", "XLA_")))
+    info["Relevant env vars"] = {k: os.environ[k] for k in env_keys}
+    return info
+
+
+def env_command(args):
+    info = gather_env_info(getattr(args, "config_file", None))
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, value in info.items():
+        if isinstance(value, dict):
+            print(f"- {key}:")
+            for k, v in value.items():
+                print(f"\t- {k}: {v}")
+        else:
+            print(f"- {key}: {value}")
+
+
+def main():
+    env_command(env_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""`accelerate-tpu estimate-memory` — dtype-wise memory table for a model
+(reference ``commands/estimate.py:215-309``).
+
+The reference meta-loads a Hub model with ``init_empty_weights`` and prints
+per-dtype sizes for params, gradients and Adam state.  Same math here: the
+model is materialized shape-only — torch models on the ``meta`` device, flax
+models via ``jax.eval_shape`` — so no weight bytes are ever allocated.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional, Tuple
+
+description = "Estimate per-dtype memory for training/inference of a model, without downloading weights."
+
+DTYPE_BYTES = {
+    "float32": 4, "fp32": 4, "f32": 4,
+    "float16": 2, "fp16": 2, "bfloat16": 2, "bf16": 2,
+    "int8": 1, "int4": 0.5,
+}
+
+
+def estimate_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
+    parser.add_argument("model_name", help="Hub model id or local path.")
+    parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"],
+                        choices=list(DTYPE_BYTES))
+    parser.add_argument("--trust_remote_code", action="store_true")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def count_parameters(model_name: str, trust_remote_code: bool = False) -> Tuple[int, int, str]:
+    """(total_params, largest_layer_params, pretty_name) via shape-only init.
+
+    Uses transformers on the torch ``meta`` device (the reference's
+    ``create_empty_model``, ``commands/estimate.py:60-130``, minus the
+    accelerate dependency — plain ``torch.device("meta")`` is enough).
+    """
+    import torch
+    from transformers import AutoConfig, AutoModel
+
+    config = AutoConfig.from_pretrained(model_name, trust_remote_code=trust_remote_code)
+    with torch.device("meta"):
+        model = AutoModel.from_config(config, trust_remote_code=trust_remote_code)
+    total = sum(p.numel() for p in model.parameters())
+    # largest single layer = what must fit while streaming weights in
+    largest = 0
+    for module in model.modules():
+        if not list(module.children()):  # leaf
+            size = sum(p.numel() for p in module.parameters(recurse=False))
+            largest = max(largest, size)
+    return total, largest, model.__class__.__name__
+
+
+def count_flax_parameters(model, *example_args, **example_kwargs) -> int:
+    """Shape-only param count for a flax module via ``jax.eval_shape``
+    (``init_empty_weights`` analog for the JAX side)."""
+    import jax
+
+    shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), *example_args, **example_kwargs))
+    import math
+
+    return sum(math.prod(s.shape) for s in jax.tree_util.tree_leaves(shapes))
+
+
+def estimate_training_usage(total_params: int, dtype: str) -> dict:
+    """Adam training footprint (reference ``estimate_training_usage``,
+    ``commands/estimate.py:215-249``): params + grads + fp32 master + 2x Adam."""
+    b = DTYPE_BYTES[dtype]
+    return {
+        "params": int(total_params * b),
+        "grads": int(total_params * b),
+        "master_params": 0 if b == 4 else total_params * 4,
+        "optimizer": total_params * 8,  # Adam m + v in fp32
+    }
+
+
+def format_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} PB"
+
+
+def build_table(model_name: str, dtypes: List[str], trust_remote_code: bool = False) -> List[dict]:
+    total, largest, pretty = count_parameters(model_name, trust_remote_code)
+    rows = []
+    for dtype in dtypes:
+        b = DTYPE_BYTES[dtype]
+        training = estimate_training_usage(total, dtype)
+        rows.append({
+            "model": pretty,
+            "dtype": dtype,
+            "params": total,
+            "largest_layer": format_bytes(largest * b),
+            "inference": format_bytes(total * b),
+            "training_adam": format_bytes(sum(training.values())),
+        })
+    return rows
+
+
+def estimate_command(args):
+    rows = build_table(args.model_name, args.dtypes, args.trust_remote_code)
+    headers = ["dtype", "Largest Layer", "Inference", "Training (Adam)"]
+    print(f"Memory usage for `{args.model_name}` ({rows[0]['params']:,} params):\n")
+    widths = [10, 16, 14, 16]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for r in rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(
+            [r["dtype"], r["largest_layer"], r["inference"], r["training_adam"]], widths)))
+
+
+def main():
+    estimate_command(estimate_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,271 @@
+"""`accelerate-tpu launch` — configure env and spawn the training script.
+
+Reference: ``commands/launch.py`` (arg groups + dispatch to
+simple/multi-gpu/deepspeed/tpu launchers) and ``utils/launch.py:76-273`` (env
+builders).  The TPU-native topology is simpler than torchelastic's: JAX is
+multi-controller SPMD with **one process per host** that drives every local
+chip, so "launching" means (a) serializing config into ``ACCELERATE_*`` env
+vars — the same cross-process config IPC the reference uses — and (b) exec'ing
+the script once per host.  Multi-host rendezvous happens inside
+``PartialState`` via ``jax.distributed.initialize`` (``state.py:79-92``), the
+analog of the reference's ``MASTER_ADDR`` protocol.
+
+For CPU-only rigs (`--cpu --num_processes N`) we fork N local processes that
+rendezvous over localhost — the working analog of the reference's
+``debug_launcher`` gloo path, used by the test suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .config.config_args import ClusterConfig, load_config_from_file, parse_mesh_spec
+
+description = "Launch a script on one or several hosts of a TPU pod (or CPU, for tests)."
+
+
+def launch_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("launch", description=description, allow_abbrev=False)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu launch", description=description, allow_abbrev=False)
+
+    parser.add_argument("--config_file", default=None, help="Config file from `accelerate-tpu config`.")
+    # hardware / topology (reference 'Hardware Selection' + 'Resource Selection' groups)
+    hw = parser.add_argument_group("Hardware and topology")
+    hw.add_argument("--cpu", action="store_true", help="Force CPU execution (tests/debug).")
+    hw.add_argument("--num_machines", type=int, default=None, help="Number of hosts (JAX processes).")
+    hw.add_argument("--machine_rank", type=int, default=None, help="This host's index.")
+    hw.add_argument("--main_process_ip", default=None, help="Coordinator host IP.")
+    hw.add_argument("--main_process_port", type=int, default=None, help="Coordinator port.")
+    hw.add_argument(
+        "--num_processes",
+        type=int,
+        default=None,
+        help="CPU debug mode only: number of local processes to fork (reference debug_launcher).",
+    )
+    hw.add_argument("--num_cpu_devices", type=int, default=None,
+                    help="CPU debug mode: virtual devices per process (xla_force_host_platform_device_count).")
+    # training config
+    tr = parser.add_argument_group("Training")
+    tr.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16"])
+    tr.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    tr.add_argument("--debug", action="store_true", help="Collective shape-check mode.")
+    tr.add_argument("--mesh", default=None, help='Mesh axes, e.g. "dp=-1" or "fsdp=4,tp=2".')
+    tr.add_argument("--dcn_mesh", default=None, help='Cross-slice (DCN) axes, e.g. "dp=2".')
+    # FSDP group (reference FSDP_* envs, utils/launch.py:214-243)
+    fsdp = parser.add_argument_group("FSDP")
+    fsdp.add_argument("--use_fsdp", action="store_true")
+    fsdp.add_argument("--fsdp_sharding_strategy", default=None)
+    fsdp.add_argument("--fsdp_offload_params", action="store_true")
+    fsdp.add_argument("--fsdp_min_num_params", type=int, default=None)
+    fsdp.add_argument("--fsdp_state_dict_type", default=None)
+    fsdp.add_argument("--fsdp_activation_checkpointing", action="store_true")
+    # ZeRO group (reference deepspeed args)
+    zero = parser.add_argument_group("ZeRO")
+    zero.add_argument("--use_deepspeed", "--use_zero", dest="use_zero", action="store_true")
+    zero.add_argument("--zero_stage", type=int, default=None)
+    zero.add_argument("--offload_optimizer_device", default=None, choices=["none", "cpu"])
+    zero.add_argument("--offload_param_device", default=None, choices=["none", "cpu"])
+    # model parallel group (reference MEGATRON_LM_* envs)
+    mp = parser.add_argument_group("Model parallelism")
+    mp.add_argument("--use_megatron_lm", "--use_model_parallel", dest="use_model_parallel", action="store_true")
+    mp.add_argument("--tp_degree", type=int, default=None)
+    mp.add_argument("--pp_degree", type=int, default=None)
+    mp.add_argument("--sequence_parallelism", action="store_true")
+
+    parser.add_argument("-m", "--module", action="store_true", help="Treat the script as a python module.")
+    parser.add_argument("training_script", help="Script (or module with -m) to launch.")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER, help="Script arguments.")
+    if subparsers is not None:
+        parser.set_defaults(func=launch_command)
+    return parser
+
+
+def _merge_with_config(args) -> ClusterConfig:
+    """CLI flags override config-file values (reference ``_validate_launch_command``)."""
+    try:
+        config = load_config_from_file(args.config_file)
+    except FileNotFoundError:
+        if args.config_file is not None:
+            raise
+        config = ClusterConfig()
+    for attr in ("num_machines", "machine_rank", "main_process_ip", "main_process_port",
+                 "mixed_precision", "gradient_accumulation_steps"):
+        val = getattr(args, attr, None)
+        if val is not None:
+            setattr(config, attr, val)
+    if args.cpu:
+        config.use_cpu = True
+    if args.debug:
+        config.debug = True
+    if args.mesh:
+        config.mesh = parse_mesh_spec(args.mesh)
+    if args.dcn_mesh:
+        config.dcn_mesh = parse_mesh_spec(args.dcn_mesh)
+    if args.use_fsdp or args.fsdp_sharding_strategy:
+        fc = dict(config.fsdp_config)
+        if args.fsdp_sharding_strategy is not None:
+            fc["sharding_strategy"] = args.fsdp_sharding_strategy
+        if args.fsdp_offload_params:
+            fc["offload_params"] = True
+        if args.fsdp_min_num_params is not None:
+            fc["min_num_params"] = args.fsdp_min_num_params
+        if args.fsdp_state_dict_type is not None:
+            fc["state_dict_type"] = args.fsdp_state_dict_type
+        if args.fsdp_activation_checkpointing:
+            fc["activation_checkpointing"] = True
+        fc.setdefault("sharding_strategy", "FULL_SHARD")
+        config.fsdp_config = fc
+    if args.use_zero or args.zero_stage is not None:
+        zc = dict(config.zero_config)
+        if args.zero_stage is not None:
+            zc["zero_stage"] = args.zero_stage
+        if args.offload_optimizer_device is not None:
+            zc["offload_optimizer_device"] = args.offload_optimizer_device
+        if args.offload_param_device is not None:
+            zc["offload_param_device"] = args.offload_param_device
+        zc.setdefault("zero_stage", 2)
+        config.zero_config = zc
+    if args.use_model_parallel or args.tp_degree or args.pp_degree:
+        mc = dict(config.model_parallel_config)
+        if args.tp_degree is not None:
+            mc["tp_degree"] = args.tp_degree
+        if args.pp_degree is not None:
+            mc["pp_degree"] = args.pp_degree
+        if args.sequence_parallelism:
+            mc["sequence_parallelism"] = True
+        config.model_parallel_config = mc
+    return config
+
+
+def prepare_launch_env(config: ClusterConfig) -> Dict[str, str]:
+    """Serialize config → ``ACCELERATE_*`` env vars, the cross-process config IPC
+    (reference ``utils/launch.py:152-273``).  Keys match what ``PartialState``
+    (``state.py:45-47``) and the plugin dataclasses rehydrate from."""
+    env: Dict[str, str] = {}
+    env["ACCELERATE_MIXED_PRECISION"] = config.mixed_precision
+    if config.debug:
+        env["ACCELERATE_DEBUG_MODE"] = "true"
+    if config.gradient_accumulation_steps and config.gradient_accumulation_steps != 1:
+        env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(config.gradient_accumulation_steps)
+    if config.num_machines > 1:
+        if not config.main_process_ip:
+            raise ValueError("--main_process_ip is required when num_machines > 1.")
+        port = config.main_process_port or 8476
+        env["ACCELERATE_COORDINATOR_ADDRESS"] = f"{config.main_process_ip}:{port}"
+        env["ACCELERATE_NUM_PROCESSES"] = str(config.num_machines)
+        env["ACCELERATE_PROCESS_ID"] = str(config.machine_rank)
+    if config.mesh:
+        env["ACCELERATE_MESH"] = ",".join(f"{k}={v}" for k, v in config.mesh.items())
+    if config.dcn_mesh:
+        env["ACCELERATE_DCN_MESH"] = ",".join(f"{k}={v}" for k, v in config.dcn_mesh.items())
+    if config.use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ACCELERATE_USE_CPU"] = "true"
+    fc = config.fsdp_config
+    if fc:
+        env["ACCELERATE_USE_FSDP"] = "true"
+        if fc.get("sharding_strategy"):
+            env["FSDP_SHARDING_STRATEGY"] = str(fc["sharding_strategy"])
+        if fc.get("offload_params"):
+            env["FSDP_OFFLOAD_PARAMS"] = "true"
+        if fc.get("min_num_params") is not None:
+            env["FSDP_MIN_NUM_PARAMS"] = str(fc["min_num_params"])
+        if fc.get("state_dict_type"):
+            env["FSDP_STATE_DICT_TYPE"] = str(fc["state_dict_type"])
+        if fc.get("activation_checkpointing"):
+            env["FSDP_ACTIVATION_CHECKPOINTING"] = "true"
+    zc = config.zero_config
+    if zc:
+        env["ACCELERATE_USE_DEEPSPEED"] = "true"
+        if zc.get("zero_stage") is not None:
+            env["ACCELERATE_DEEPSPEED_ZERO_STAGE"] = str(zc["zero_stage"])
+        if zc.get("offload_optimizer_device"):
+            env["ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"] = str(zc["offload_optimizer_device"])
+        if zc.get("offload_param_device"):
+            env["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"] = str(zc["offload_param_device"])
+    mc = config.model_parallel_config
+    if mc:
+        env["ACCELERATE_USE_MEGATRON_LM"] = "true"
+        if mc.get("tp_degree") is not None:
+            env["MEGATRON_LM_TP_DEGREE"] = str(mc["tp_degree"])
+        if mc.get("pp_degree") is not None:
+            env["MEGATRON_LM_PP_DEGREE"] = str(mc["pp_degree"])
+        if mc.get("sequence_parallelism"):
+            env["MEGATRON_LM_SEQUENCE_PARALLELISM"] = "true"
+    return env
+
+
+def _script_cmd(args) -> List[str]:
+    cmd = [sys.executable]
+    if args.module:
+        cmd += ["-m", args.training_script]
+    else:
+        cmd.append(args.training_script)
+    cmd += args.training_script_args
+    return cmd
+
+
+def simple_launcher(args, config: ClusterConfig) -> int:
+    """One process on this host (reference ``simple_launcher``/``tpu_launcher``
+    collapsed: a single JAX process drives all local chips)."""
+    env = {**os.environ, **prepare_launch_env(config)}
+    proc = subprocess.run(_script_cmd(args), env=env)
+    return proc.returncode
+
+
+def multi_process_cpu_launcher(args, config: ClusterConfig, num_processes: int) -> int:
+    """Fork N local processes rendezvousing over localhost (reference
+    ``debug_launcher``: fork + gloo; here fork + jax.distributed on CPU)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base_env = prepare_launch_env(config)
+    base_env["ACCELERATE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    base_env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    if args.num_cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        base_env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={args.num_cpu_devices}".strip()
+    procs = []
+    for rank in range(num_processes):
+        env = {**os.environ, **base_env,
+               "ACCELERATE_PROCESS_ID": str(rank), "ACCELERATE_LOCAL_PROCESS_ID": str(rank)}
+        procs.append(subprocess.Popen(_script_cmd(args), env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def launch_command(args) -> None:
+    config = _merge_with_config(args)
+    if config.use_cpu and args.num_processes and args.num_processes > 1:
+        rc = multi_process_cpu_launcher(args, config, args.num_processes)
+    else:
+        if args.num_processes and args.num_processes > 1:
+            raise ValueError(
+                "--num_processes > 1 is CPU-debug only. On TPU, one process per host drives "
+                "all local chips; use --num_machines/--machine_rank for multi-host pods."
+            )
+        rc = simple_launcher(args, config)
+    if rc:
+        sys.exit(rc)
+
+
+def main():
+    parser = launch_command_parser()
+    args = parser.parse_args()
+    launch_command(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded safetensors checkpoint
+into one file (reference ``commands/merge.py`` over ``utils/fsdp_utils.py:218-276``).
+
+The reference merges FSDP ``SHARDED_STATE_DICT`` torch.distributed-checkpoint
+shards.  Here the input is this framework's own sharded export
+(``model-XXXXX-of-YYYYY.safetensors`` + ``model.safetensors.index.json``,
+written by ``checkpointing.save_model``) and the output is a single
+``model.safetensors`` — loadable anywhere in the HF ecosystem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+description = "Merge a sharded safetensors checkpoint into a single file."
+
+
+def merge_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights", description=description)
+    parser.add_argument("checkpoint_directory", help="Directory containing the sharded checkpoint.")
+    parser.add_argument("output_path", help="Output directory (or .safetensors file path).")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_weights(checkpoint_directory: str, output_path: str) -> str:
+    from safetensors.numpy import save_file
+
+    from ..checkpointing import MODEL_SAFE_NAME, _flatten_params, load_model_params
+
+    tree = load_model_params(checkpoint_directory)
+    flat = _flatten_params(tree)
+    if output_path.endswith(".safetensors"):
+        out_file = output_path
+        os.makedirs(os.path.dirname(os.path.abspath(out_file)), exist_ok=True)
+    else:
+        os.makedirs(output_path, exist_ok=True)
+        out_file = os.path.join(output_path, MODEL_SAFE_NAME)
+    save_file(flat, out_file)
+    return out_file
+
+
+def merge_command(args):
+    out = merge_weights(args.checkpoint_directory, args.output_path)
+    print(f"Merged checkpoint written to {out}")
+
+
+def main():
+    merge_command(merge_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""`accelerate-tpu test` — run the bundled self-test script under the
+configured launch topology (reference ``commands/test.py:22-57``)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+description = "Run accelerate_tpu's bundled self-test script to verify the environment."
+
+
+def test_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
+    parser.add_argument("--config_file", default=None, help="Config from `accelerate-tpu config`.")
+    parser.add_argument("--cpu", action="store_true", help="Run the self-test on CPU.")
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args):
+    import accelerate_tpu.test_utils.test_script as test_script
+
+    script = os.path.abspath(test_script.__file__)
+    from .launch import launch_command, launch_command_parser
+
+    launch_args = ["--num_processes", "1"]
+    if args.config_file:
+        launch_args += ["--config_file", args.config_file]
+    if args.cpu:
+        launch_args += ["--cpu"]
+    launch_args.append(script)
+    parsed = launch_command_parser().parse_args(launch_args)
+    launch_command(parsed)
+    print("Test is a success! You are ready for your distributed training!")
+
+
+def main():
+    test_command(test_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""`accelerate-tpu tpu-config` — fan a command out to every worker of a GCP TPU
+pod over SSH (reference ``commands/tpu.py:90-152``).
+
+Builds the ``gcloud compute tpus tpu-vm ssh --worker=all`` command line; the
+typical use is installing deps and starting ``accelerate-tpu launch`` on each
+host of a pod slice.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+from typing import List, Optional
+
+description = "Run commands on each worker of a GCP TPU pod (install deps, start training)."
+
+
+def tpu_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config", description=description)
+    cfg = parser.add_argument_group("Config")
+    cfg.add_argument("--config_file", default=None, help="Config from `accelerate-tpu config`.")
+    cfg.add_argument("--tpu_name", default=None, help="TPU name (overrides config).")
+    cfg.add_argument("--tpu_zone", default=None, help="GCP zone (overrides config).")
+    pod = parser.add_argument_group("TPU Arguments")
+    pod.add_argument("--use_alpha", action="store_true", help="Use `gcloud alpha` instead of `gcloud`.")
+    pod.add_argument("--command_file", default=None, help="File with commands to run on startup.")
+    pod.add_argument("--command", action="append", help="Command to run (repeatable).")
+    pod.add_argument("--install_accelerate", action="store_true",
+                     help="Prepend a pip install of this framework.")
+    pod.add_argument("--accelerate_version", default="latest")
+    pod.add_argument("--debug", action="store_true", help="Print the command instead of running it.")
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def build_tpu_command(
+    tpu_name: str,
+    tpu_zone: str,
+    commands: List[str],
+    use_alpha: bool = False,
+    use_sudo: bool = False,
+) -> List[str]:
+    sep = "; "
+    script = sep.join(("sudo " + c if use_sudo else c) for c in commands)
+    cmd = ["gcloud"]
+    if use_alpha:
+        cmd.append("alpha")
+    cmd += [
+        "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        "--zone", tpu_zone,
+        "--command", script,
+        "--worker", "all",
+    ]
+    return cmd
+
+
+def tpu_command_launcher(args):
+    config = None
+    tpu_name, tpu_zone, use_sudo = args.tpu_name, args.tpu_zone, False
+    commands: List[str] = []
+    if args.config_file is not None or (tpu_name is None or tpu_zone is None):
+        from .config.config_args import load_config_from_file
+
+        try:
+            config = load_config_from_file(args.config_file)
+        except FileNotFoundError:
+            config = None
+    if config is not None:
+        tpu_name = tpu_name or config.tpu_name
+        tpu_zone = tpu_zone or config.tpu_zone
+        use_sudo = config.tpu_use_sudo
+        if config.commands:
+            commands += config.commands
+        if config.command_file and args.command_file is None:
+            args.command_file = config.command_file
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands += [line.strip() for line in f if line.strip()]
+    if args.command:
+        commands += args.command
+    if args.install_accelerate:
+        version = args.accelerate_version
+        pkg = "accelerate-tpu" if version == "latest" else f"accelerate-tpu=={version}"
+        commands.insert(0, f"pip install {pkg}")
+    if not tpu_name or not tpu_zone:
+        raise ValueError("Both --tpu_name and --tpu_zone are required (flag or config file).")
+    if not commands:
+        raise ValueError("No commands given (use --command, --command_file, or the config file).")
+    cmd = build_tpu_command(tpu_name, tpu_zone, commands, args.use_alpha, use_sudo)
+    if args.debug:
+        print(f"Running {' '.join(cmd)}")
+        return
+    subprocess.run(cmd)
+    print("Successfully setup pod.")
+
+
+def main():
+    tpu_command_launcher(tpu_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

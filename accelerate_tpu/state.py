@@ -362,6 +362,9 @@ class AcceleratorState:
             return getattr(ps, name)
         raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
 
+    def __repr__(self):
+        return repr(self.partial_state) + f"Mixed precision type: {self.mixed_precision}\n"
+
     @classmethod
     def _reset_state(cls, reset_partial_state: bool = False):
         cls._shared_state.clear()

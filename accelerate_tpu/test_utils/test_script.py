@@ -1,0 +1,96 @@
+"""Bundled self-test script run by `accelerate-tpu test` (reference
+``test_utils/scripts/test_script.py``: process checks, RNG sync, DL
+preparation, training convergence).
+
+Ships with the package so a fresh install can validate its environment:
+``accelerate-tpu test`` launches this under the configured topology.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def process_execution_check(accelerator):
+    state = accelerator.state
+    assert state.num_processes >= 1
+    assert 0 <= state.process_index < state.num_processes
+    accelerator.wait_for_everyone()
+    with accelerator.main_process_first():
+        pass
+    # split_between_processes (reference test_script.py:hundreds)
+    with accelerator.split_between_processes(list(range(10)), apply_padding=False) as chunk:
+        assert len(chunk) >= 10 // state.num_processes
+    print(f"[{state.process_index}] process execution: OK")
+
+
+def collectives_check(accelerator):
+    import jax.numpy as jnp
+
+    x = jnp.arange(4.0) + accelerator.process_index
+    gathered = accelerator.gather(x)
+    assert gathered.shape[0] == 4 * max(accelerator.num_processes, 1)
+    red = accelerator.reduce(x, reduction="sum")
+    assert red.shape == x.shape
+    print(f"[{accelerator.process_index}] collectives: OK")
+
+
+def dl_preparation_check(accelerator):
+    from accelerate_tpu import SimpleDataLoader
+
+    data = [{"x": np.array([float(i)])} for i in range(32)]
+    dl = accelerator.prepare(SimpleDataLoader(data, batch_size=8))
+    seen = []
+    for batch in dl:
+        seen.append(np.asarray(batch["x"]).reshape(-1))
+    total = np.concatenate(seen)
+    # every index must appear across the epoch (per process view covers the epoch)
+    assert len(total) >= 32 // max(accelerator.num_processes, 1)
+    print(f"[{accelerator.process_index}] dataloader preparation: OK")
+
+
+def training_check(accelerator):
+    """Distributed training must match the closed-form least-squares fit."""
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import SimpleDataLoader
+
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    W = rng.normal(size=(4, 1)).astype(np.float32)
+    Y = X @ W
+    data = [{"x": X[i], "y": Y[i]} for i in range(64)]
+    dl = accelerator.prepare(SimpleDataLoader(data, batch_size=16, shuffle=True))
+    state = accelerator.create_train_state(
+        params={"w": jnp.zeros((4, 1))}, tx=optax.adam(5e-2)
+    )
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step = accelerator.compile_train_step(loss_fn)
+    for _ in range(40):
+        for batch in dl:
+            state, metrics = step(state, batch)
+    final = float(metrics["loss"])
+    assert final < 1e-3, f"training did not converge: loss={final}"
+    np.testing.assert_allclose(np.asarray(state.params["w"]), W, atol=0.05)
+    print(f"[{accelerator.process_index}] training convergence: OK (loss={final:.2e})")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    accelerator.print(f"Topology: {accelerator.state}")
+    process_execution_check(accelerator)
+    collectives_check(accelerator)
+    dl_preparation_check(accelerator)
+    training_check(accelerator)
+    accelerator.print("All self-tests passed.")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,21 @@ def parse_choice_from_env(key: str, default: str = "no") -> str:
     return os.environ.get(key, str(default))
 
 
+def parse_mesh_spec(spec: str):
+    """Parse ``"dp=2,fsdp=4,tp=-1"`` into an axes dict (``--mesh`` flag /
+    ``ACCELERATE_MESH`` env; serialized by ``commands/launch.py``)."""
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"Bad mesh spec segment {part!r}; expected name=size")
+        name, size = part.split("=", 1)
+        axes[name.strip()] = int(size)
+    return axes
+
+
 class EnumWithContains(enum.EnumMeta):
     def __contains__(cls, item):
         try:

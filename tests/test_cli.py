@@ -1,0 +1,298 @@
+"""CLI tests (reference tests/test_cli.py: config round-trip, launch arg
+merging, env builders, tpu-config command construction, merge-weights)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import yaml
+
+from accelerate_tpu.commands.accelerate_cli import get_parser
+from accelerate_tpu.commands.config.config_args import ClusterConfig, parse_mesh_spec
+from accelerate_tpu.commands.estimate import DTYPE_BYTES, estimate_training_usage, format_bytes
+from accelerate_tpu.commands.launch import _merge_with_config, launch_command_parser, prepare_launch_env
+from accelerate_tpu.commands.merge import merge_weights
+from accelerate_tpu.commands.tpu import build_tpu_command
+
+
+class TestClusterConfig:
+    def test_yaml_round_trip(self, tmp_path):
+        cfg = ClusterConfig(
+            num_machines=4,
+            machine_rank=1,
+            main_process_ip="10.0.0.1",
+            main_process_port=8476,
+            mixed_precision="bf16",
+            mesh={"fsdp": 4, "tp": 2},
+            fsdp_config={"sharding_strategy": "FULL_SHARD"},
+        )
+        path = str(tmp_path / "cfg.yaml")
+        cfg.to_yaml_file(path)
+        loaded = ClusterConfig.from_yaml_file(path)
+        assert loaded == cfg
+
+    def test_json_round_trip(self, tmp_path):
+        cfg = ClusterConfig(mixed_precision="fp16", zero_config={"zero_stage": 3})
+        path = str(tmp_path / "cfg.json")
+        cfg.to_json_file(path)
+        assert ClusterConfig.from_json_file(path) == cfg
+
+    def test_unknown_keys_rejected(self, tmp_path):
+        path = tmp_path / "bad.yaml"
+        path.write_text(yaml.safe_dump({"mixed_precision": "no", "bogus_key": 1}))
+        with pytest.raises(ValueError, match="bogus_key"):
+            ClusterConfig.from_yaml_file(str(path))
+
+    def test_parse_mesh_spec(self):
+        assert parse_mesh_spec("dp=2,fsdp=4,tp=-1") == {"dp": 2, "fsdp": 4, "tp": -1}
+        with pytest.raises(ValueError):
+            parse_mesh_spec("dp2")
+
+
+class TestLaunchEnvBuilders:
+    def test_basic_env(self):
+        cfg = ClusterConfig(mixed_precision="bf16", gradient_accumulation_steps=4, debug=True)
+        env = prepare_launch_env(cfg)
+        assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+        assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "4"
+        assert env["ACCELERATE_DEBUG_MODE"] == "true"
+
+    def test_multihost_env(self):
+        cfg = ClusterConfig(num_machines=4, machine_rank=2, main_process_ip="10.0.0.9", main_process_port=1234)
+        env = prepare_launch_env(cfg)
+        assert env["ACCELERATE_COORDINATOR_ADDRESS"] == "10.0.0.9:1234"
+        assert env["ACCELERATE_NUM_PROCESSES"] == "4"
+        assert env["ACCELERATE_PROCESS_ID"] == "2"
+
+    def test_multihost_requires_ip(self):
+        cfg = ClusterConfig(num_machines=2)
+        with pytest.raises(ValueError, match="main_process_ip"):
+            prepare_launch_env(cfg)
+
+    def test_fsdp_env(self):
+        cfg = ClusterConfig(fsdp_config={
+            "sharding_strategy": "FULL_SHARD", "offload_params": True,
+            "min_num_params": 1000, "activation_checkpointing": True,
+        })
+        env = prepare_launch_env(cfg)
+        assert env["ACCELERATE_USE_FSDP"] == "true"
+        assert env["FSDP_SHARDING_STRATEGY"] == "FULL_SHARD"
+        assert env["FSDP_OFFLOAD_PARAMS"] == "true"
+        assert env["FSDP_MIN_NUM_PARAMS"] == "1000"
+        assert env["FSDP_ACTIVATION_CHECKPOINTING"] == "true"
+
+    def test_zero_env(self):
+        cfg = ClusterConfig(zero_config={"zero_stage": 3, "offload_optimizer_device": "cpu"})
+        env = prepare_launch_env(cfg)
+        assert env["ACCELERATE_USE_DEEPSPEED"] == "true"
+        assert env["ACCELERATE_DEEPSPEED_ZERO_STAGE"] == "3"
+        assert env["ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"] == "cpu"
+
+    def test_model_parallel_env(self):
+        cfg = ClusterConfig(model_parallel_config={"tp_degree": 4, "pp_degree": 2, "sequence_parallelism": True})
+        env = prepare_launch_env(cfg)
+        assert env["MEGATRON_LM_TP_DEGREE"] == "4"
+        assert env["MEGATRON_LM_PP_DEGREE"] == "2"
+        assert env["MEGATRON_LM_SEQUENCE_PARALLELISM"] == "true"
+
+    def test_mesh_env(self):
+        cfg = ClusterConfig(mesh={"fsdp": 4, "tp": 2}, dcn_mesh={"dp": 2})
+        env = prepare_launch_env(cfg)
+        assert env["ACCELERATE_MESH"] == "fsdp=4,tp=2"
+        assert env["ACCELERATE_DCN_MESH"] == "dp=2"
+
+
+class TestLaunchArgMerging:
+    def _parse(self, argv):
+        return launch_command_parser().parse_args(argv)
+
+    def test_flags_override_config(self, tmp_path):
+        cfg = ClusterConfig(mixed_precision="no", num_machines=1)
+        path = str(tmp_path / "cfg.yaml")
+        cfg.to_yaml_file(path)
+        args = self._parse(["--config_file", path, "--mixed_precision", "bf16", "script.py"])
+        merged = _merge_with_config(args)
+        assert merged.mixed_precision == "bf16"
+
+    def test_fsdp_flags(self):
+        args = self._parse(["--use_fsdp", "--fsdp_min_num_params", "500", "script.py"])
+        merged = _merge_with_config(args)
+        assert merged.fsdp_config["sharding_strategy"] == "FULL_SHARD"
+        assert merged.fsdp_config["min_num_params"] == 500
+
+    def test_zero_flags(self):
+        args = self._parse(["--use_zero", "--zero_stage", "3", "script.py"])
+        merged = _merge_with_config(args)
+        assert merged.zero_config["zero_stage"] == 3
+
+    def test_script_args_passthrough(self):
+        args = self._parse(["script.py", "--lr", "1e-3", "--epochs", "3"])
+        assert args.training_script == "script.py"
+        assert args.training_script_args == ["--lr", "1e-3", "--epochs", "3"]
+
+    def test_mesh_flag(self):
+        args = self._parse(["--mesh", "fsdp=8", "script.py"])
+        assert _merge_with_config(args).mesh == {"fsdp": 8}
+
+
+class TestCliParser:
+    def test_all_subcommands_registered(self):
+        parser = get_parser()
+        sub = next(a for a in parser._actions if hasattr(a, "choices") and a.choices)
+        for cmd in ["config", "env", "launch", "test", "estimate-memory", "merge-weights", "tpu-config"]:
+            assert cmd in sub.choices
+
+    def test_config_default_subcommand(self, tmp_path):
+        from accelerate_tpu.commands.accelerate_cli import main
+
+        path = str(tmp_path / "default.yaml")
+        main(["config", "default", "--config_file", path, "--mixed_precision", "bf16", "--mesh", "dp=-1"])
+        loaded = ClusterConfig.from_yaml_file(path)
+        assert loaded.mixed_precision == "bf16"
+        assert loaded.mesh == {"dp": -1}
+
+
+class TestTpuConfig:
+    def test_build_command(self):
+        cmd = build_tpu_command("my-pod", "us-central2-b", ["pip install x", "echo hi"], use_sudo=True)
+        assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "my-pod"]
+        assert "--worker" in cmd and "all" in cmd
+        joined = cmd[cmd.index("--command") + 1]
+        assert joined == "sudo pip install x; sudo echo hi"
+
+    def test_alpha(self):
+        cmd = build_tpu_command("p", "z", ["x"], use_alpha=True)
+        assert cmd[1] == "alpha"
+
+
+class TestEstimate:
+    def test_training_usage_fp32(self):
+        usage = estimate_training_usage(1000, "float32")
+        assert usage["params"] == 4000
+        assert usage["grads"] == 4000
+        assert usage["master_params"] == 0
+        assert usage["optimizer"] == 8000
+
+    def test_training_usage_bf16_has_master(self):
+        usage = estimate_training_usage(1000, "bf16")
+        assert usage["params"] == 2000
+        assert usage["master_params"] == 4000
+
+    def test_format_bytes(self):
+        assert format_bytes(1024**3) == "1.00 GB"
+
+    def test_flax_param_count(self):
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from accelerate_tpu.commands.estimate import count_flax_parameters
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(7)(x)
+
+        n = count_flax_parameters(Tiny(), jnp.ones((1, 3)))
+        assert n == 3 * 7 + 7
+
+
+class TestMergeWeights:
+    def test_merge_sharded(self, tmp_path):
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.checkpointing import load_model_params, save_model
+
+        acc = Accelerator()
+        params = {"layer": {"w": np.arange(600, dtype=np.float32).reshape(30, 20), "b": np.zeros(20, np.float32)}}
+        shard_dir = str(tmp_path / "sharded")
+        written = save_model(acc, params, shard_dir, max_shard_size="1KB")
+        assert len(written) > 1  # actually sharded
+        out = merge_weights(shard_dir, str(tmp_path / "merged"))
+        merged = load_model_params(os.path.dirname(out))
+        np.testing.assert_array_equal(merged["layer"]["w"], params["layer"]["w"])
+
+
+class TestLaunchEndToEnd:
+    def test_simple_launch_runs_script(self, tmp_path):
+        script = tmp_path / "probe.py"
+        out = tmp_path / "out.json"
+        script.write_text(
+            "import os, json\n"
+            "keys = ['ACCELERATE_MIXED_PRECISION', 'ACCELERATE_MESH', 'ACCELERATE_GRADIENT_ACCUMULATION_STEPS']\n"
+            f"json.dump({{k: os.environ.get(k) for k in keys}}, open({str(out)!r}, 'w'))\n"
+        )
+        env = {k: v for k, v in os.environ.items() if not k.startswith("ACCELERATE")}
+        proc = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu", "launch", "--cpu",
+             "--mixed_precision", "bf16", "--mesh", "dp=-1",
+             "--gradient_accumulation_steps", "2", str(script)],
+            env={**env, "PYTHONPATH": os.getcwd()},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        result = json.loads(out.read_text())
+        assert result["ACCELERATE_MIXED_PRECISION"] == "bf16"
+        assert result["ACCELERATE_MESH"] == "dp=-1"
+        assert result["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "2"
+
+    def test_env_command_runs(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu", "env"],
+            env={**os.environ, "PYTHONPATH": os.getcwd()},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "accelerate_tpu" in proc.stdout
+        assert "JAX version" in proc.stdout
+
+
+class TestDebugLauncher:
+    def test_two_process_rendezvous(self, tmp_path):
+        # Full tier-2 analog: two spawned CPU processes rendezvous and agree on
+        # process_count (reference debug_launcher + gloo).
+        script = tmp_path / "worker.py"
+        marker = tmp_path / "ok"
+        script.write_text(
+            "from accelerate_tpu import debug_launcher\n"
+            "import pathlib\n"
+            "def fn():\n"
+            "    import jax\n"
+            "    assert jax.process_count() == 2, jax.process_count()\n"
+            "    pathlib.Path(r'%s').with_suffix('.' + str(jax.process_index())).touch()\n"
+            "if __name__ == '__main__':\n"
+            "    debug_launcher(fn, num_processes=2)\n" % marker
+        )
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("ACCELERATE") and k != "XLA_FLAGS"}
+        proc = subprocess.run(
+            [sys.executable, str(script)],
+            env={**env, "PYTHONPATH": os.getcwd(), "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        assert marker.with_suffix(".0").exists() and marker.with_suffix(".1").exists()
+
+
+class TestEnvMeshPluginValidation:
+    def test_env_mesh_missing_fsdp_axis_raises(self, monkeypatch):
+        from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin
+
+        monkeypatch.setenv("ACCELERATE_MESH", "dp=-1")
+        with pytest.raises(ValueError, match="lacks axes \\['fsdp'\\]"):
+            Accelerator(fsdp_plugin=FullyShardedDataParallelPlugin())
+
+    def test_env_mesh_with_fsdp_axis_ok(self, monkeypatch):
+        from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin
+
+        monkeypatch.setenv("ACCELERATE_MESH", "fsdp=8")
+        acc = Accelerator(fsdp_plugin=FullyShardedDataParallelPlugin())
+        assert dict(acc.mesh.shape) == {"fsdp": 8}
+
+    def test_env_mesh_plain_dp(self, monkeypatch):
+        from accelerate_tpu import Accelerator
+
+        monkeypatch.setenv("ACCELERATE_MESH", "dp=-1")
+        acc = Accelerator()
+        assert dict(acc.mesh.shape) == {"dp": 8}
