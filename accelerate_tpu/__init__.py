@@ -23,6 +23,15 @@ from .data_loader import (
     prepare_data_loader,
     skip_first_batches,
 )
+from .big_modeling import (
+    StreamingTransformer,
+    cpu_offload,
+    disk_offload,
+    dispatch_params,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    shard_params_for_inference,
+)
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .optimizer import AcceleratedOptimizer

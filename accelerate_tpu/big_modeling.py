@@ -1,0 +1,426 @@
+"""Big-model inference: shape-only init, device maps, offload, streaming forward
+(reference ``big_modeling.py`` L6 + ``hooks.py`` offload engine).
+
+Reference mechanism: meta-device init (``init_empty_weights``,
+``big_modeling.py:56``), greedy device-map packing (``infer_auto_device_map``),
+checkpoint dispatch (``load_checkpoint_and_dispatch``, ``:499``) and per-forward
+weight streaming via ``AlignDevicesHook`` (``hooks.py:322-389``).
+
+TPU-native re-design:
+
+* meta init ≡ ``jax.eval_shape`` — abstract trees with zero allocation;
+* when the model fits in pooled HBM, ``device_map="sharded"`` places every
+  weight with a ``NamedSharding`` over the mesh and one jitted apply runs it —
+  GSPMD inserts the collectives; no hooks, no python in the hot loop;
+* for the overflow case, :class:`StreamingTransformer` is the AlignDevicesHook
+  analog: per-layer jitted compute (ONE executable reused by every layer — all
+  decoder layers share shapes) with double-buffered host→HBM transfers: layer
+  ``i+1``'s weights stream while layer ``i`` computes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .utils.modeling import (
+    DeviceId,
+    SEP,
+    compute_module_sizes,
+    flatten_tree,
+    get_balanced_memory,
+    get_max_layer_size,
+    infer_auto_device_map,
+    top_level_modules,
+    unflatten_tree,
+)
+from .utils.offload import OffloadedWeightsLoader, offload_state_dict
+
+
+# --------------------------------------------------------------------- init
+def init_empty_weights(model, *args, method: str = "init", rng=None, **kwargs):
+    """Abstract (shape-only) parameter tree — the ``init_empty_weights`` analog
+    (reference ``big_modeling.py:56-166``; here no monkey-patching: JAX's
+    abstract interpretation is first-class via ``jax.eval_shape``)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    fn = getattr(model, method)
+    shapes = jax.eval_shape(lambda: fn(rng, *args, **kwargs))
+    return shapes["params"] if isinstance(shapes, dict) and "params" in shapes else shapes
+
+
+def checkpoint_shapes(checkpoint: str) -> Dict[str, jax.ShapeDtypeStruct]:
+    """Flat {path: ShapeDtypeStruct} read from safetensors headers — no
+    tensor bytes are touched (the on-disk analog of meta init)."""
+    from safetensors import safe_open
+
+    flat: Dict[str, jax.ShapeDtypeStruct] = {}
+    by_file: Dict[str, list] = {}
+    for key, fname in _checkpoint_files(checkpoint).items():
+        by_file.setdefault(fname, []).append(key)
+    for fname, keys in by_file.items():  # one open + header parse per file
+        with safe_open(fname, framework="np") as f:
+            for key in keys:
+                sl = f.get_slice(key)
+                flat[key] = jax.ShapeDtypeStruct(
+                    tuple(sl.get_shape()), _SAFETENSORS_DTYPES[sl.get_dtype()]
+                )
+    return flat
+
+
+_SAFETENSORS_DTYPES = {
+    "BOOL": np.dtype(np.bool_),
+    "U8": np.dtype(np.uint8), "I8": np.dtype(np.int8),
+    "U16": np.dtype(np.uint16), "I16": np.dtype(np.int16),
+    "U32": np.dtype(np.uint32), "I32": np.dtype(np.int32),
+    "U64": np.dtype(np.uint64), "I64": np.dtype(np.int64),
+    "F16": np.dtype(np.float16), "F32": np.dtype(np.float32), "F64": np.dtype(np.float64),
+    "BF16": jnp.bfloat16,
+}
+
+
+def _checkpoint_files(checkpoint: str) -> Dict[str, str]:
+    """{tensor_name: safetensors file path} for a single-file or sharded
+    (``model.safetensors.index.json``) checkpoint."""
+    import json
+
+    if os.path.isfile(checkpoint):
+        files = [checkpoint]
+        index = None
+    else:
+        index_path = os.path.join(checkpoint, "model.safetensors.index.json")
+        single = os.path.join(checkpoint, "model.safetensors")
+        if os.path.isfile(index_path):
+            with open(index_path) as f:
+                index = json.load(f)
+            return {
+                key: os.path.join(checkpoint, fname)
+                for key, fname in index["weight_map"].items()
+            }
+        elif os.path.isfile(single):
+            files, index = [single], None
+        else:
+            raise FileNotFoundError(f"No safetensors checkpoint found at {checkpoint}")
+    from safetensors import safe_open
+
+    mapping: Dict[str, str] = {}
+    for fname in files:
+        with safe_open(fname, framework="np") as f:
+            for key in f.keys():
+                mapping[key] = fname
+    return mapping
+
+
+# ----------------------------------------------------------------- dispatch
+def _validate_device_map(device_map: Dict[str, DeviceId], modules, what: str = "model") -> None:
+    """An explicit device_map must cover exactly the top-level modules —
+    silently defaulting uncovered layers to device 0 would defeat the offload
+    the caller asked for (or OOM)."""
+    known = set(modules)
+    unknown = [k for k in device_map if k not in known]
+    missing = [m for m in known if m not in device_map]
+    if unknown:
+        raise ValueError(
+            f"device_map keys {unknown} are not modules of this {what} "
+            f"(modules: {sorted(known)}). To pass per-device byte budgets use "
+            "max_memory=... with device_map='auto'."
+        )
+    if missing:
+        raise ValueError(
+            f"device_map does not cover modules {sorted(missing)}; every top-level "
+            "module needs a placement (device index, 'cpu', or 'disk')."
+        )
+
+
+def dispatch_params(
+    params,
+    device_map: Dict[str, DeviceId],
+    offload_folder: Optional[str] = None,
+) -> Tuple[Any, Optional[OffloadedWeightsLoader]]:
+    """Place each top-level module's weights per ``device_map`` (reference
+    ``dispatch_model``, ``big_modeling.py:305-496``).
+
+    Device-mapped modules go to HBM (``jax.device_put``); ``"cpu"`` modules
+    stay as host numpy arrays; ``"disk"`` modules are written to
+    ``offload_folder`` memory-maps and dropped from RAM.  Returns the placed
+    tree (disk leaves become ``None``) plus the weights loader covering
+    cpu+disk entries for streaming.
+    """
+    _validate_device_map(device_map, top_level_modules(params))
+    devices = jax.devices()
+    placed: Dict[str, Any] = {}
+    host_entries: Dict[str, Any] = {}
+    disk_flat: Dict[str, Any] = {}
+    for mod in top_level_modules(params):
+        target = device_map[mod]
+        sub = params[mod]
+        if target == "disk":
+            if offload_folder is None:
+                raise ValueError("device_map places modules on 'disk' but no offload_folder was given.")
+            disk_flat.update(flatten_tree(sub, mod))
+            placed[mod] = None
+        elif target == "cpu":
+            sub = jax.tree_util.tree_map(np.asarray, sub)
+            host_entries.update(flatten_tree(sub, mod))
+            placed[mod] = sub
+        else:
+            placed[mod] = jax.device_put(sub, devices[int(target)])
+    loader = None
+    if disk_flat:
+        offload_state_dict(offload_folder, {k: np.asarray(v) for k, v in disk_flat.items()})
+        loader = OffloadedWeightsLoader(state_dict=host_entries, save_folder=offload_folder)
+    elif host_entries:
+        loader = OffloadedWeightsLoader(state_dict=host_entries)
+    return placed, loader
+
+
+def shard_params_for_inference(params, mesh=None, axis: Optional[str] = None):
+    """Pooled-HBM placement: shard every weight's largest divisible dim over the
+    mesh and let GSPMD handle the rest — the TPU answer to ``device_map`` when
+    the model fits in aggregate HBM (SURVEY §7.10)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        from .state import PartialState
+
+        mesh = PartialState().mesh
+    axes = list(mesh.shape.keys()) if axis is None else [axis]
+    sizes = {a: mesh.shape[a] for a in axes}
+    total = int(np.prod(list(sizes.values())))
+
+    def place(x):
+        x = jnp.asarray(x)
+        best_dim, best_axes = None, ()
+        for d, dim_size in enumerate(x.shape):
+            if dim_size % total == 0:
+                best_dim, best_axes = d, tuple(axes)
+                break
+        if best_dim is None:
+            for d, dim_size in enumerate(x.shape):
+                for a in axes:
+                    if dim_size % sizes[a] == 0:
+                        best_dim, best_axes = d, (a,)
+                        break
+                if best_dim is not None:
+                    break
+        spec = [None] * jnp.ndim(x)
+        if best_dim is not None:
+            spec[best_dim] = best_axes if len(best_axes) > 1 else best_axes[0]
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+    return jax.tree_util.tree_map(place, params)
+
+
+def cpu_offload(params, exec_device_map: Optional[Dict[str, DeviceId]] = None):
+    """Everything on host, streamed per-forward (reference ``cpu_offload``,
+    ``big_modeling.py:169-211``)."""
+    device_map = {mod: "cpu" for mod in top_level_modules(params)}
+    if exec_device_map:
+        device_map.update(exec_device_map)
+    return dispatch_params(params, device_map)
+
+
+def disk_offload(params, offload_folder: str):
+    """Everything on disk memory-maps (reference ``disk_offload``,
+    ``big_modeling.py:214-260``)."""
+    device_map = {mod: "disk" for mod in top_level_modules(params)}
+    return dispatch_params(params, device_map, offload_folder=offload_folder)
+
+
+# ------------------------------------------------- checkpoint → dispatched
+def load_checkpoint_and_dispatch(
+    model,
+    checkpoint: str,
+    device_map: Union[str, Dict[str, DeviceId]] = "auto",
+    max_memory: Optional[Dict[DeviceId, int]] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    mesh=None,
+):
+    """Load a safetensors checkpoint with placement decided *before* any tensor
+    is read (reference ``load_checkpoint_and_dispatch``, ``big_modeling.py:499-628``).
+
+    ``device_map``:
+      * ``"sharded"`` — shard into pooled HBM via NamedSharding (TPU-preferred);
+      * ``"auto"``/``"balanced"`` — greedy packing over device budgets, spilling
+        to cpu/disk;
+      * explicit dict — your placement.
+
+    Returns ``(params, device_map, weights_loader)``; disk-mapped tensors are
+    NOT copied — the loader reads them zero-copy from the checkpoint itself.
+    """
+    flat_shapes = checkpoint_shapes(checkpoint)
+    abstract = unflatten_tree(flat_shapes)
+    files = _checkpoint_files(checkpoint)
+
+    if device_map == "sharded":
+        flat = _read_tensors(files, list(files.keys()), dtype)
+        params = shard_params_for_inference(unflatten_tree(flat), mesh=mesh)
+        return params, "sharded", None
+
+    if isinstance(device_map, str):
+        if device_map not in ("auto", "balanced", "balanced_low_0"):
+            raise ValueError(f"Unknown device_map {device_map!r}")
+        budgets = get_balanced_memory(
+            abstract, max_memory, dtype=dtype, low_zero=device_map == "balanced_low_0"
+        )
+        device_map = infer_auto_device_map(abstract, budgets, dtype=dtype)
+
+    _validate_device_map(device_map, top_level_modules(abstract), what="checkpoint")
+    devices = jax.devices()
+    placed: Dict[str, Any] = {}
+    host_entries: Dict[str, Any] = {}
+    safetensors_refs: Dict[str, str] = {}
+    for mod in top_level_modules(abstract):
+        target = device_map[mod]
+        keys = [k for k in flat_shapes if k == mod or k.startswith(mod + SEP)]
+        if target == "disk":
+            # zero-copy: leave bytes in the checkpoint, remember the file
+            for k in keys:
+                safetensors_refs[k] = files[k]
+            placed[mod] = None
+        elif target == "cpu":
+            flat = _read_tensors(files, keys, dtype)
+            host_entries.update(flat)
+            placed[mod] = unflatten_tree({k[len(mod) + 1:]: v for k, v in flat.items()})
+        else:
+            flat = _read_tensors(files, keys, dtype)
+            sub = unflatten_tree({k[len(mod) + 1:]: v for k, v in flat.items()})
+            placed[mod] = jax.device_put(sub, devices[int(target)])
+    loader = None
+    if host_entries or safetensors_refs:
+        loader = OffloadedWeightsLoader(state_dict=host_entries, safetensors_files=safetensors_refs)
+    return placed, device_map, loader
+
+
+def _read_tensors(files: Dict[str, str], keys, dtype=None) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    by_file: Dict[str, list] = {}
+    for k in keys:
+        by_file.setdefault(files[k], []).append(k)
+    out: Dict[str, np.ndarray] = {}
+    for fname, ks in by_file.items():
+        with safe_open(fname, framework="np") as f:
+            for k in ks:
+                t = f.get_tensor(k)
+                if dtype is not None:
+                    t = t.astype(jnp.dtype(dtype))
+                out[k] = t
+    return out
+
+
+# ------------------------------------------------------- streaming executor
+class StreamingTransformer:
+    """Layer-streaming forward for the flagship Transformer — the TPU
+    ``AlignDevicesHook`` (reference ``hooks.py:219-396``) redesigned:
+
+    * one jitted per-layer executable shared by all layers (same shapes);
+    * double buffering: layer ``i+1``'s ``jax.device_put`` (async) is issued
+      before layer ``i``'s compute, so PCIe/DMA overlaps the MXU;
+    * modules already resident on the exec device skip the transfer.
+    """
+
+    def __init__(
+        self,
+        config,
+        params,
+        device_map: Optional[Dict[str, DeviceId]] = None,
+        weights_loader=None,
+        exec_device=None,
+    ):
+        from .models.transformer import DecoderLayer, RMSNorm, Transformer  # noqa: F401
+
+        self.config = config
+        self.params = params
+        self.device_map = device_map or {}
+        self.loader = weights_loader
+        self.device = exec_device if exec_device is not None else jax.devices()[0]
+        cfg = config
+        # scan_layers=True models store ONE stacked "layers" module (axis 0 =
+        # depth, models/transformer.py:185-198) instead of layers_{i}; stream
+        # by slicing the stack per layer.
+        self._scan_layout = bool(getattr(cfg, "scan_layers", False)) or (
+            isinstance(params, dict) and "layers" in params and "layers_0" not in params
+        )
+        self._layer_names = [f"layers_{i}" for i in range(cfg.num_layers)]
+
+        def layer_fn(layer_params, x, positions):
+            return DecoderLayer(cfg).apply({"params": layer_params}, x, positions)
+
+        def embed_fn(embed_params, ids):
+            import flax.linen as nn
+
+            embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+            return embed.apply({"params": embed_params}, ids)
+
+        def head_fn(norm_params, head_params, x):
+            from .models.transformer import RMSNorm
+
+            x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": norm_params}, x)
+            if cfg.tie_word_embeddings:
+                return (x.astype(cfg.param_dtype) @ head_params["embedding"].T).astype(jnp.float32)
+            return (x @ head_params["kernel"].astype(cfg.dtype)).astype(jnp.float32)
+
+        self._layer_jit = jax.jit(layer_fn)
+        self._embed_jit = jax.jit(embed_fn)
+        self._head_jit = jax.jit(head_fn)
+
+    # -- module weight access ---------------------------------------------
+    def _layer_params(self, i: int):
+        if not self._scan_layout:
+            return self._module_params(self._layer_names[i])
+        stacked = self._module_params("layers")["layer"]
+        return jax.tree_util.tree_map(lambda x: x[i], stacked)
+
+    def _module_params(self, name: str):
+        sub = self.params.get(name) if isinstance(self.params, dict) else None
+        if sub is not None:
+            return sub
+        if self.loader is None:
+            raise KeyError(f"No weights for module {name!r}")
+        flat = {
+            k[len(name) + 1:]: self.loader[k]
+            for k in self.loader
+            if k.startswith(name + SEP)
+        }
+        if not flat:
+            raise KeyError(f"No weights for module {name!r}")
+        return unflatten_tree(flat)
+
+    def _to_device(self, tree):
+        def put(x):
+            if isinstance(x, jax.Array) and x.committed and x.devices() == {self.device}:
+                return x
+            return jax.device_put(x, self.device)
+
+        return jax.tree_util.tree_map(put, tree)
+
+    # -- forward -----------------------------------------------------------
+    def __call__(self, input_ids, positions=None):
+        cfg = self.config
+        input_ids = jnp.asarray(input_ids)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1])[None, :], input_ids.shape)
+        x = self._embed_jit(self._to_device(self._module_params("embed_tokens")), input_ids)
+
+        # double-buffered layer streaming
+        n_layers = len(self._layer_names)
+        current = self._to_device(self._layer_params(0))
+        for i in range(n_layers):
+            nxt = None
+            if i + 1 < n_layers:
+                # async transfer of layer i+1 issued before layer i computes
+                nxt = self._to_device(self._layer_params(i + 1))
+            x = self._layer_jit(current, x, positions)
+            current = nxt
+
+        norm = self._to_device(self._module_params("final_norm"))
+        if cfg.tie_word_embeddings:
+            head = self._to_device(self._module_params("embed_tokens"))
+        else:
+            head = self._to_device(self._module_params("lm_head"))
+        return self._head_jit(norm, head, x)

@@ -278,25 +278,11 @@ def _find_seedable_sampler(dl) -> Optional[SeedableRandomSampler]:
 
 
 # ----------------------------------------------------------- safetensors model
-def _flatten_params(params, prefix: str = "") -> Dict[str, Any]:
-    flat = {}
-    if isinstance(params, dict):
-        for k, v in params.items():
-            flat.update(_flatten_params(v, f"{prefix}{k}."))
-    else:
-        flat[prefix[:-1]] = params
-    return flat
-
-
-def _unflatten_params(flat: Dict[str, Any]) -> Dict[str, Any]:
-    tree: Dict[str, Any] = {}
-    for key, value in flat.items():
-        parts = key.split(".")
-        node = tree
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = value
-    return tree
+# Single source of truth for the '.'-separated safetensors key convention —
+# shared with device-map dispatch (utils/modeling.py) so checkpoint save/load
+# and big-model placement can never desynchronize.
+from .utils.modeling import flatten_tree as _flatten_params  # noqa: E402
+from .utils.modeling import unflatten_tree as _unflatten_params  # noqa: E402
 
 
 def parse_size(size) -> int:

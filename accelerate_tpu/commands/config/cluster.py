@@ -17,16 +17,24 @@ def _ask(prompt: str, default: str = "", convert: Optional[Callable] = None, cho
     suffix = f" [{default}]" if default != "" else ""
     if choices:
         prompt = f"{prompt} ({'/'.join(choices)})"
-    try:
-        raw = input(f"{prompt}{suffix}: ").strip()
-    except EOFError:
-        raw = ""
-    if raw == "":
-        raw = default
-    if choices and raw not in choices:
-        print(f"  invalid choice {raw!r}, using {default!r}")
-        raw = default
-    return convert(raw) if convert else raw
+    while True:  # re-prompt on bad input instead of losing the whole session
+        try:
+            raw = input(f"{prompt}{suffix}: ").strip()
+        except EOFError:
+            raw = ""
+        if raw == "":
+            raw = default
+        if choices and raw not in choices:
+            print(f"  invalid choice {raw!r}, using {default!r}")
+            raw = default
+        if convert is None:
+            return raw
+        try:
+            return convert(raw)
+        except (TypeError, ValueError) as e:
+            if raw == default:
+                raise  # a broken default is a bug, not user error
+            print(f"  invalid value {raw!r} ({e}); try again")
 
 
 def _ask_bool(prompt: str, default: bool = False) -> bool:

@@ -211,10 +211,21 @@ def _script_cmd(args) -> List[str]:
     return cmd
 
 
+def _apply_cpu_device_count(env: Dict[str, str], num_cpu_devices: Optional[int]) -> None:
+    if num_cpu_devices:
+        flags = env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={num_cpu_devices}".strip()
+
+
 def simple_launcher(args, config: ClusterConfig) -> int:
     """One process on this host (reference ``simple_launcher``/``tpu_launcher``
     collapsed: a single JAX process drives all local chips)."""
-    env = {**os.environ, **prepare_launch_env(config)}
+    launch_env = prepare_launch_env(config)
+    if config.use_cpu:
+        _apply_cpu_device_count(launch_env, args.num_cpu_devices)
+    elif args.num_cpu_devices:
+        raise ValueError("--num_cpu_devices only applies with --cpu.")
+    env = {**os.environ, **launch_env}
     proc = subprocess.run(_script_cmd(args), env=env)
     return proc.returncode
 
@@ -231,9 +242,7 @@ def multi_process_cpu_launcher(args, config: ClusterConfig, num_processes: int) 
     base_env["ACCELERATE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     base_env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
     base_env["JAX_PLATFORMS"] = "cpu"
-    if args.num_cpu_devices:
-        flags = os.environ.get("XLA_FLAGS", "")
-        base_env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={args.num_cpu_devices}".strip()
+    _apply_cpu_device_count(base_env, args.num_cpu_devices)
     procs = []
     for rank in range(num_processes):
         env = {**os.environ, **base_env,

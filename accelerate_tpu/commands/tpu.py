@@ -94,7 +94,12 @@ def tpu_command_launcher(args):
     if args.debug:
         print(f"Running {' '.join(cmd)}")
         return
-    subprocess.run(cmd)
+    proc = subprocess.run(cmd)
+    if proc.returncode != 0:
+        import sys
+
+        print(f"Pod setup failed (gcloud exited {proc.returncode}).", file=sys.stderr)
+        raise SystemExit(proc.returncode)
     print("Successfully setup pod.")
 
 

@@ -1,0 +1,182 @@
+"""Model-size math and device-map inference (reference ``utils/modeling.py``:
+``compute_module_sizes`` :627, ``get_balanced_memory`` :952,
+``infer_auto_device_map`` :1095).
+
+The reference walks an ``nn.Module`` hierarchy; the JAX analog walks a param
+pytree whose nested keys *are* the module hierarchy (flax naming), so "module"
+here means a path prefix like ``layers_0`` or ``layers_0/attn``.  Sizes are
+computed from abstract (``jax.eval_shape``) or concrete trees alike — no
+weight bytes needed.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+PathTree = Any
+DeviceId = Union[int, str]  # device index | "cpu" | "disk"
+
+SEP = "."  # matches checkpointing._flatten_params / HF safetensors key convention
+
+
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element (reference ``utils/modeling.py:126-146``)."""
+    dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
+    if hasattr(dtype, "itemsize"):
+        return dtype.itemsize
+    raise ValueError(f"Cannot size dtype {dtype}")
+
+
+def _leaf_nbytes(leaf, dtype=None) -> int:
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return 0
+    if dtype is not None:
+        return int(math.prod(shape)) * int(np.dtype(jax.numpy.dtype(dtype)).itemsize)
+    ldtype = getattr(leaf, "dtype", np.dtype("float32"))
+    return int(math.prod(shape)) * int(np.dtype(ldtype).itemsize)
+
+
+def flatten_tree(tree, prefix: str = "") -> Dict[str, Any]:
+    """{'layers_0/attn/q_proj/kernel': leaf} — flax param tree to flat paths."""
+    flat: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for key, value in tree.items():
+            path = f"{prefix}{SEP}{key}" if prefix else str(key)
+            flat.update(flatten_tree(value, path))
+    else:
+        flat[prefix] = tree
+    return flat
+
+
+def unflatten_tree(flat: Dict[str, Any]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        parts = path.split(SEP)
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def compute_module_sizes(tree: PathTree, dtype=None) -> Dict[str, int]:
+    """Size in bytes of every path prefix, '' = whole model
+    (reference ``compute_module_sizes``, ``utils/modeling.py:627-660``)."""
+    sizes: Dict[str, int] = defaultdict(int)
+    for path, leaf in flatten_tree(tree).items():
+        nbytes = _leaf_nbytes(leaf, dtype)
+        sizes[""] += nbytes
+        parts = path.split(SEP)
+        for i in range(1, len(parts) + 1):
+            sizes[SEP.join(parts[:i])] += nbytes
+    return dict(sizes)
+
+
+def get_max_layer_size(tree: PathTree, no_split_prefixes: Tuple[str, ...] = (), dtype=None) -> Tuple[int, List[str]]:
+    """Largest un-splittable block (reference ``get_max_layer_size``,
+    ``utils/modeling.py:708-760``): the biggest thing that must fit on one
+    device while streaming."""
+    sizes = compute_module_sizes(tree, dtype)
+    top_level = top_level_modules(tree)
+    best, names = 0, []
+    for mod in top_level:
+        s = sizes.get(mod, 0)
+        if s > best:
+            best, names = s, [mod]
+        elif s == best:
+            names.append(mod)
+    return best, names
+
+
+def top_level_modules(tree: PathTree) -> List[str]:
+    """First-level keys of the param tree, natural-sorted so ``layers_2`` <
+    ``layers_10`` (greedy packing must follow execution order)."""
+    if not isinstance(tree, dict):
+        return []
+
+    def natkey(s: str):
+        return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
+
+    return sorted(tree.keys(), key=natkey)
+
+
+def get_balanced_memory(
+    tree: PathTree,
+    max_memory: Optional[Dict[DeviceId, int]] = None,
+    num_devices: Optional[int] = None,
+    dtype=None,
+    low_zero: bool = False,
+) -> Dict[DeviceId, int]:
+    """Even per-device budgets (reference ``get_balanced_memory``,
+    ``utils/modeling.py:952-1075``): spread the model across devices instead of
+    greedily filling device 0.  ``low_zero`` leaves device 0 mostly free (the
+    reference's ``balanced_low_0`` for generate() workloads)."""
+    if max_memory is not None:
+        return dict(max_memory)
+    n = num_devices if num_devices is not None else len(jax.devices())
+    total = compute_module_sizes(tree, dtype)[""]
+    max_layer, _ = get_max_layer_size(tree, dtype=dtype)
+    active = n - 1 if (low_zero and n > 1) else n
+    per_device = total // max(active, 1) + max_layer
+    budgets: Dict[DeviceId, int] = {i: per_device for i in range(n)}
+    if low_zero and n > 1:
+        budgets[0] = max_layer
+    budgets["cpu"] = 10**15
+    budgets["disk"] = 10**18
+    return budgets
+
+
+def infer_auto_device_map(
+    tree: PathTree,
+    max_memory: Optional[Dict[DeviceId, int]] = None,
+    no_split_prefixes: Tuple[str, ...] = (),
+    dtype=None,
+    num_devices: Optional[int] = None,
+    offload_buffers: bool = False,
+) -> Dict[str, DeviceId]:
+    """Greedy packing of top-level modules across devices → cpu → disk
+    (reference ``infer_auto_device_map``, ``utils/modeling.py:1095-1396``).
+
+    Returns ``{module_prefix: device}``; modules are packed in execution order
+    so neighbouring layers land on the same device (minimal inter-device hops
+    during a forward pass).
+    """
+    n = num_devices if num_devices is not None else len(jax.devices())
+    budgets = get_balanced_memory(tree, max_memory, n, dtype) if max_memory is None else dict(max_memory)
+    sizes = compute_module_sizes(tree, dtype)
+    order: List[DeviceId] = [i for i in range(n) if budgets.get(i, 0) > 0]
+    order += [d for d in ("cpu", "disk") if budgets.get(d, 0) > 0]
+    if not order:
+        raise ValueError("All device budgets are zero; cannot place the model.")
+    device_map: Dict[str, DeviceId] = {}
+    used: Dict[DeviceId, int] = defaultdict(int)
+    cursor = 0
+    for mod in top_level_modules(tree):
+        size = sizes.get(mod, 0)
+        placed = False
+        while cursor < len(order):
+            dev = order[cursor]
+            if used[dev] + size <= budgets[dev]:
+                device_map[mod] = dev
+                used[dev] += size
+                placed = True
+                break
+            cursor += 1  # device full — move on (never backtrack: execution order)
+        if not placed:
+            raise ValueError(
+                f"Module {mod!r} ({size} bytes) does not fit anywhere. "
+                f"Budgets: { {d: budgets[d] for d in order} }, used: {dict(used)}."
+            )
+    return device_map
+
+
+def named_module_tensors(tree: PathTree, prefix: str = "") -> Dict[str, Any]:
+    """Alias of :func:`flatten_tree` for reference-API familiarity."""
+    return flatten_tree(tree, prefix)

@@ -1,0 +1,150 @@
+"""Disk-offloaded weights (reference ``utils/offload.py:25-213``).
+
+``offload_state_dict`` writes each array to a raw ``.dat`` file plus one
+``index.json`` with dtype/shape; ``OffloadedWeightsLoader`` is a lazy mapping
+over (a) in-memory arrays, (b) those ``.dat`` memory-maps, and (c) tensors
+still inside safetensors checkpoints (read zero-copy via ``safe_open`` on
+access).  On TPU the loader's consumers stream values straight into
+``jax.device_put`` — the mmap never fully materializes in host RAM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[Dict] = None) -> Dict:
+    """Write one array as ``<name>.dat`` (reference ``offload_weight``,
+    ``utils/offload.py:25-47``)."""
+    weight = np.asarray(weight)
+    dtype = str(weight.dtype)
+    if dtype == "bfloat16":
+        # np.memmap has no bf16; store the raw bytes as int16 (reference stores
+        # torch bf16 as int16 the same way, utils/offload.py:37-41)
+        weight = weight.view(np.int16)
+    array_path = os.path.join(offload_folder, f"{weight_name}.dat")
+    # weight names are tree paths ("layers_0/attn/...") → nested dirs
+    os.makedirs(os.path.dirname(array_path), exist_ok=True)
+    file_array = np.memmap(array_path, dtype=weight.dtype, mode="w+", shape=weight.shape or (1,))
+    if weight.shape == ():
+        file_array[0] = weight
+    else:
+        file_array[:] = weight[:]
+    file_array.flush()
+    if index is not None:
+        index[weight_name] = {"dtype": dtype, "shape": list(weight.shape)}
+    return index if index is not None else {weight_name: {"dtype": dtype, "shape": list(weight.shape)}}
+
+
+def load_offloaded_weight(weight_file: str, weight_info: Dict) -> np.ndarray:
+    """Memory-map one ``.dat`` back (reference ``load_offloaded_weight``,
+    ``utils/offload.py:50-71``)."""
+    shape = tuple(weight_info["shape"])
+    dtype = weight_info["dtype"]
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        raw = np.memmap(weight_file, dtype=np.int16, mode="r", shape=shape or (1,))
+        arr = raw.view(jnp.bfloat16.dtype)
+    else:
+        arr = np.memmap(weight_file, dtype=np.dtype(dtype), mode="r", shape=shape or (1,))
+    if shape == ():
+        arr = arr.reshape(())
+    return arr
+
+
+def offload_state_dict(save_dir: str, state_dict: Dict[str, Any]) -> None:
+    """Offload a flat dict of arrays to ``save_dir`` (reference
+    ``offload_state_dict``, ``utils/offload.py:74-94``)."""
+    os.makedirs(save_dir, exist_ok=True)
+    index: Dict[str, Dict] = {}
+    for name, value in state_dict.items():
+        index = offload_weight(value, name, save_dir, index=index)
+    save_offload_index(index, save_dir)
+
+
+def save_offload_index(index: Dict, offload_folder: str) -> None:
+    if not index:
+        return
+    index_path = os.path.join(offload_folder, "index.json")
+    if os.path.isfile(index_path):
+        with open(index_path) as f:
+            existing = json.load(f)
+        existing.update(index)
+        index = existing
+    with open(index_path, "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> Dict[str, Dict]:
+    index_path = os.path.join(offload_folder, "index.json")
+    if not os.path.isfile(index_path):
+        return {}
+    with open(index_path) as f:
+        return json.load(f)
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy mapping over in-memory + disk-offloaded + safetensors-resident
+    weights (reference ``OffloadedWeightsLoader``, ``utils/offload.py:127-213``)."""
+
+    def __init__(
+        self,
+        state_dict: Optional[Dict[str, Any]] = None,
+        save_folder: Optional[str] = None,
+        index: Optional[Dict[str, Dict]] = None,
+        safetensors_files: Optional[Dict[str, str]] = None,
+    ):
+        if state_dict is None and save_folder is None and not safetensors_files:
+            raise ValueError("Need at least one of state_dict, save_folder, safetensors_files.")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        self.index = dict(index if index is not None else (load_offload_index(save_folder) if save_folder else {}))
+        # {tensor_name: safetensors file containing it}
+        self.safetensors_files = dict(safetensors_files or {})
+        self.all_keys = list(self.state_dict)
+        self.all_keys += [k for k in self.index if k not in self.all_keys]
+        self.all_keys += [k for k in self.safetensors_files if k not in self.all_keys]
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        if key in self.index:
+            weight_file = os.path.join(self.save_folder, f"{key}.dat")
+            return load_offloaded_weight(weight_file, self.index[key])
+        if key in self.safetensors_files:
+            from safetensors import safe_open
+
+            with safe_open(self.safetensors_files[key], framework="np") as f:
+                return f.get_tensor(key)
+        raise KeyError(key)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.all_keys)
+
+    def __len__(self) -> int:
+        return len(self.all_keys)
+
+
+class PrefixedDataset(Mapping):
+    """View of a mapping under a key prefix (reference ``PrefixedDataset``,
+    ``utils/offload.py:97-124``): lets a per-module consumer see only its
+    weights."""
+
+    def __init__(self, dataset: Mapping, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, key):
+        return self.dataset[f"{self.prefix}{key}"]
+
+    def __iter__(self):
+        return iter(k[len(self.prefix):] for k in self.dataset if k.startswith(self.prefix))
+
+    def __len__(self):
+        return sum(1 for k in self.dataset if k.startswith(self.prefix))
