@@ -52,14 +52,16 @@ def init_empty_weights(model, *args, method: str = "init", rng=None, **kwargs):
     return shapes["params"] if isinstance(shapes, dict) and "params" in shapes else shapes
 
 
-def checkpoint_shapes(checkpoint: str) -> Dict[str, jax.ShapeDtypeStruct]:
+def checkpoint_shapes(
+    checkpoint: str, files: Optional[Dict[str, str]] = None
+) -> Dict[str, jax.ShapeDtypeStruct]:
     """Flat {path: ShapeDtypeStruct} read from safetensors headers — no
     tensor bytes are touched (the on-disk analog of meta init)."""
     from safetensors import safe_open
 
     flat: Dict[str, jax.ShapeDtypeStruct] = {}
     by_file: Dict[str, list] = {}
-    for key, fname in _checkpoint_files(checkpoint).items():
+    for key, fname in (files if files is not None else _checkpoint_files(checkpoint)).items():
         by_file.setdefault(fname, []).append(key)
     for fname, keys in by_file.items():  # one open + header parse per file
         with safe_open(fname, framework="np") as f:
@@ -252,9 +254,9 @@ def load_checkpoint_and_dispatch(
     Returns ``(params, device_map, weights_loader)``; disk-mapped tensors are
     NOT copied — the loader reads them zero-copy from the checkpoint itself.
     """
-    flat_shapes = checkpoint_shapes(checkpoint)
-    abstract = unflatten_tree(flat_shapes)
     files = _checkpoint_files(checkpoint)
+    flat_shapes = checkpoint_shapes(checkpoint, files=files)
+    abstract = unflatten_tree(flat_shapes)
 
     if device_map == "sharded":
         flat = _read_tensors(files, list(files.keys()), dtype)
@@ -285,15 +287,21 @@ def load_checkpoint_and_dispatch(
         elif target == "cpu":
             flat = _read_tensors(files, keys, dtype)
             host_entries.update(flat)
-            placed[mod] = unflatten_tree({k[len(mod) + 1:]: v for k, v in flat.items()})
+            placed[mod] = _strip_prefix(flat, mod)
         else:
             flat = _read_tensors(files, keys, dtype)
-            sub = unflatten_tree({k[len(mod) + 1:]: v for k, v in flat.items()})
-            placed[mod] = jax.device_put(sub, devices[int(target)])
+            placed[mod] = jax.device_put(_strip_prefix(flat, mod), devices[int(target)])
     loader = None
     if host_entries or safetensors_refs:
         loader = OffloadedWeightsLoader(state_dict=host_entries, safetensors_files=safetensors_refs)
     return placed, device_map, loader
+
+
+def _strip_prefix(flat: Dict[str, Any], mod: str):
+    """Subtree under ``mod`` — a root-level leaf (key == mod) IS the value."""
+    if set(flat) == {mod}:
+        return flat[mod]
+    return unflatten_tree({k[len(mod) + 1:]: v for k, v in flat.items()})
 
 
 def _read_tensors(files: Dict[str, str], keys, dtype=None) -> Dict[str, np.ndarray]:
@@ -358,23 +366,33 @@ class StreamingTransformer:
             return embed.apply({"params": embed_params}, ids)
 
         def head_fn(norm_params, head_params, x):
+            import flax.linen as nn
+
             from .models.transformer import RMSNorm
 
             x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": norm_params}, x)
             if cfg.tie_word_embeddings:
-                return (x.astype(cfg.param_dtype) @ head_params["embedding"].T).astype(jnp.float32)
+                # exact monolithic semantics: embed.attend promotes to cfg.dtype
+                # (models/transformer.py:208)
+                embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+                logits = embed.apply({"params": head_params}, x.astype(cfg.param_dtype), method="attend")
+                return logits.astype(jnp.float32)
             return (x @ head_params["kernel"].astype(cfg.dtype)).astype(jnp.float32)
 
         self._layer_jit = jax.jit(layer_fn)
         self._embed_jit = jax.jit(embed_fn)
         self._head_jit = jax.jit(head_fn)
+        self._stack_cache = None  # per-forward cache of the scanned layer stack
 
     # -- module weight access ---------------------------------------------
     def _layer_params(self, i: int):
         if not self._scan_layout:
             return self._module_params(self._layer_names[i])
-        stacked = self._module_params("layers")["layer"]
-        return jax.tree_util.tree_map(lambda x: x[i], stacked)
+        # fetch the stacked module once per forward (a loader read is a full
+        # eager deserialize — O(layers) re-reads would defeat the streaming)
+        if self._stack_cache is None:
+            self._stack_cache = self._module_params("layers")["layer"]
+        return jax.tree_util.tree_map(lambda x: x[i], self._stack_cache)
 
     def _module_params(self, name: str):
         sub = self.params.get(name) if isinstance(self.params, dict) else None
@@ -403,6 +421,7 @@ class StreamingTransformer:
     def __call__(self, input_ids, positions=None):
         cfg = self.config
         input_ids = jnp.asarray(input_ids)
+        self._stack_cache = None  # params may have been swapped between calls
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1])[None, :], input_ids.shape)
         x = self._embed_jit(self._to_device(self._module_params("embed_tokens")), input_ids)

@@ -1,5 +1,6 @@
 """Parallelism substrate: named meshes, sharding rules, collectives, model parallel."""
 
+from .pipeline import pipeline_apply, prepare_pipeline, stack_layer_params
 from .ring_attention import ring_attention, ring_attention_sharded
 from .mesh import (
     DATA_AXES,

@@ -1,0 +1,164 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a ``pp`` mesh axis.
+
+Reference surface: PiPPy inference (``inference.py:78-188`` — trace, split at
+``split_points``, schedule ``num_chunks`` microbatches) and Megatron's
+``pp_degree`` (``utils/dataclasses.py:1318``).  Those are process-rank
+pipelines with explicit send/recv; the TPU-native design is a *collective*
+pipeline (scaling-book recipe): every pp rank runs the same compiled program,
+holds one stage's layer stack, and activations rotate one hop per step with
+``lax.ppermute`` while a ``lax.scan`` walks the schedule.  Total steps =
+``num_microbatches + pp - 1`` (the classic GPipe bubble); the ppermute for
+step t+1 is independent of step t's compute, so XLA overlaps transfer with
+the MXU.
+
+Everything is differentiable (``ppermute`` has a transpose rule), so training
+backward — itself a reversed pipeline — falls out of autodiff; no separate
+1F1B machinery is needed at this level.
+
+Entry points:
+  - :func:`pipeline_apply` — generic: stage_fn + stacked per-layer params.
+  - :func:`prepare_pipeline` — the ``prepare_pippy`` analog for the flagship
+    Transformer: embed/head replicated, decoder stack pipelined.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import mesh_axis_size
+
+
+def stack_layer_params(params: dict, num_layers: int) -> Any:
+    """Stack per-layer subtrees ``layers_0..layers_{L-1}`` into one tree with a
+    leading depth axis (the ``scan_layers=True`` layout, which slices cleanly
+    into pipeline stages)."""
+    if "layers" in params:  # already scanned/stacked
+        return params["layers"]["layer"]
+    subtrees = [params[f"layers_{i}"] for i in range(num_layers)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *subtrees)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    layer_params: Any,
+    microbatches: jax.Array,
+    *broadcast_args,
+    mesh: Mesh,
+    axis: str = "pp",
+):
+    """Run ``stage_fn`` as a GPipe pipeline over ``mesh[axis]``.
+
+    ``stage_fn(local_layer_params, x, *broadcast_args) -> x`` applies one
+    stage's worth of layers; ``layer_params`` leaves have a leading depth axis
+    that shard_map splits across stages.  ``microbatches`` is ``[M, mb, ...]``
+    (replicated across ``axis``); the output has the same shape.  ``M`` should
+    be >= the pp degree to keep the bubble fraction (pp-1)/(M+pp-1) small.
+    """
+    n_stages = mesh_axis_size(mesh, axis)
+    num_micro = microbatches.shape[0]
+    if n_stages == 1:
+        out = microbatches
+        return jax.vmap(lambda mb: stage_fn(layer_params, mb, *broadcast_args))(out)
+
+    depth = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    if depth % n_stages:
+        raise ValueError(f"{depth} layers do not split into {n_stages} pipeline stages")
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def worker(local_params, mbs, *bargs):
+        idx = lax.axis_index(axis)
+        steps = num_micro + n_stages - 1
+        state = jnp.zeros_like(mbs[0])
+        out_buf = jnp.zeros_like(mbs)
+
+        def body(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t (clamped: trailing steps drain the pipe)
+            feed = lax.dynamic_index_in_dim(mbs, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False)
+            inp = jnp.where(idx == 0, feed, state)
+            out = stage_fn(local_params, inp, *bargs)
+            # last stage finished microbatch t-(n-1) — record it
+            w = t - (n_stages - 1)
+            updated = lax.dynamic_update_index_in_dim(out_buf, out, jnp.clip(w, 0, num_micro - 1), 0)
+            write = jnp.logical_and(idx == n_stages - 1, w >= 0)
+            out_buf = jnp.where(write, updated, out_buf)
+            # rotate activations one hop (overlaps with next step's compute)
+            state = lax.ppermute(out, axis, perm)
+            return (state, out_buf), None
+
+        (state, out_buf), _ = lax.scan(body, (state, out_buf), jnp.arange(steps))
+        # replicate the result (only the last stage holds it)
+        have = jnp.where(idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
+        return lax.psum(have, axis)
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
+    n_bargs = len(broadcast_args)
+    return jax.shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(param_specs, P()) + (P(),) * n_bargs,
+        out_specs=P(),
+        check_vma=False,
+    )(layer_params, microbatches, *broadcast_args)
+
+
+def prepare_pipeline(
+    model,
+    params: dict,
+    mesh: Optional[Mesh] = None,
+    num_microbatches: int = 8,
+    axis: str = "pp",
+    jit: bool = True,
+):
+    """Pipeline-parallel forward for the flagship Transformer (reference
+    ``prepare_pippy``, ``inference.py:126-188``).
+
+    Embedding, final norm and LM head run replicated on every pp rank (they
+    are small next to the decoder stack); the stacked decoder layers are split
+    into ``mesh[axis]`` stages.  Returns ``fn(params, input_ids) -> logits``.
+    """
+    from ..models.transformer import DecoderLayer, RMSNorm
+    import flax.linen as nn
+
+    cfg = model.config
+    if mesh is None:
+        from ..state import PartialState
+
+        mesh = PartialState().mesh
+
+    def stage_fn(local_layers, x, positions):
+        def body(h, layer_params):
+            return DecoderLayer(cfg).apply({"params": layer_params}, h, positions), None
+
+        x, _ = lax.scan(body, x, local_layers)
+        return x
+
+    def forward(p, input_ids):
+        b, s = input_ids.shape
+        if b % num_microbatches:
+            raise ValueError(f"Batch {b} not divisible by {num_microbatches} microbatches")
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // num_microbatches, s))
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        x = embed.apply({"params": p["embed_tokens"]}, input_ids)
+        mbs = x.reshape(num_microbatches, b // num_microbatches, s, cfg.hidden_size)
+        layer_params = stack_layer_params(p, cfg.num_layers)
+        out = pipeline_apply(stage_fn, layer_params, mbs, positions, mesh=mesh, axis=axis)
+        x = out.reshape(b, s, cfg.hidden_size)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": p["final_norm"]}, x)
+        if cfg.tie_word_embeddings:
+            # exact monolithic semantics: embed.attend promotes to cfg.dtype
+            # (models/transformer.py:208)
+            logits = embed.apply(
+                {"params": p["embed_tokens"]}, x.astype(cfg.param_dtype), method="attend"
+            )
+        else:
+            logits = x @ p["lm_head"]["kernel"].astype(cfg.dtype)
+        return logits.astype(jnp.float32)
+
+    return jax.jit(forward) if jit else forward

@@ -25,14 +25,6 @@ DeviceId = Union[int, str]  # device index | "cpu" | "disk"
 SEP = "."  # matches checkpointing._flatten_params / HF safetensors key convention
 
 
-def dtype_byte_size(dtype) -> float:
-    """Bytes per element (reference ``utils/modeling.py:126-146``)."""
-    dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
-    if hasattr(dtype, "itemsize"):
-        return dtype.itemsize
-    raise ValueError(f"Cannot size dtype {dtype}")
-
-
 def _leaf_nbytes(leaf, dtype=None) -> int:
     shape = getattr(leaf, "shape", None)
     if shape is None:
@@ -79,7 +71,7 @@ def compute_module_sizes(tree: PathTree, dtype=None) -> Dict[str, int]:
     return dict(sizes)
 
 
-def get_max_layer_size(tree: PathTree, no_split_prefixes: Tuple[str, ...] = (), dtype=None) -> Tuple[int, List[str]]:
+def get_max_layer_size(tree: PathTree, dtype=None) -> Tuple[int, List[str]]:
     """Largest un-splittable block (reference ``get_max_layer_size``,
     ``utils/modeling.py:708-760``): the biggest thing that must fit on one
     device while streaming."""
@@ -136,10 +128,8 @@ def get_balanced_memory(
 def infer_auto_device_map(
     tree: PathTree,
     max_memory: Optional[Dict[DeviceId, int]] = None,
-    no_split_prefixes: Tuple[str, ...] = (),
     dtype=None,
     num_devices: Optional[int] = None,
-    offload_buffers: bool = False,
 ) -> Dict[str, DeviceId]:
     """Greedy packing of top-level modules across devices → cpu → disk
     (reference ``infer_auto_device_map``, ``utils/modeling.py:1095-1396``).

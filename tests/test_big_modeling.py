@@ -294,3 +294,17 @@ class TestDeviceMapValidation:
         _, _, params = tiny_params()
         with pytest.raises(ValueError, match="does not cover"):
             dispatch_params(params, {"embed_tokens": 0})
+
+
+class TestTiedEmbeddingsBf16:
+    def test_streaming_matches_under_mixed_precision(self):
+        # review finding: attend() promotes to cfg.dtype; head must do the same
+        cfg = TransformerConfig.tiny(tie_word_embeddings=True)  # dtype=bf16 default
+        model = Transformer(cfg)
+        ids = jnp.ones((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        ref = model.apply({"params": params}, ids)
+        out = StreamingTransformer(cfg, params)(ids)
+        # per-jit fusion boundaries differ → up to ~1 bf16 ulp of rounding;
+        # the systematic f32-matmul bug this guards against was >> 1 ulp
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.05, atol=0.005)
