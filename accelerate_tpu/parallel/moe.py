@@ -1,0 +1,148 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+Reference surface: DeepSpeed-MoE passthrough only — ``transformer_moe_cls_names``
+(``utils/dataclasses.py:792-798``) and ``set_moe_leaf_modules``
+(``accelerator.py:1687``); the expert compute/dispatch lives in DeepSpeed CUDA.
+
+TPU-native design (GShard/Switch dense formulation): routing produces static
+``[tokens, experts, capacity]`` dispatch/combine tensors, expert ingestion and
+combination are einsums (MXU work, no ragged gathers, no dynamic shapes), and
+experts are a stacked leading axis sharded over ``ep`` — under jit, XLA lowers
+the dispatch einsum against ``ep``-sharded experts to an all-to-all over ICI.
+The router runs in fp32 (routing decisions are precision-sensitive) and the
+Switch load-balancing aux loss is sown for the trainer to pick up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def top_k_dispatch(
+    router_probs: jax.Array,  # [N, E] fp32
+    num_experts_per_tok: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """GShard top-k routing → dense dispatch/combine tensors.
+
+    Returns ``dispatch [N, E, C]`` (0/1), ``combine [N, E, C]`` (gate-weighted)
+    and the Switch aux loss (experts * Σ_e fraction_routed_e * mean_prob_e).
+    Tokens beyond an expert's capacity are dropped (their combine weight is 0) —
+    the residual connection carries them, standard Switch behavior.
+    """
+    n_tokens, n_experts = router_probs.shape
+    gates, expert_idx = jax.lax.top_k(router_probs, num_experts_per_tok)  # [N, k]
+    gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((n_tokens, n_experts, capacity), dtype=router_probs.dtype)
+    combine = jnp.zeros_like(dispatch)
+    counts = jnp.zeros((n_experts,), dtype=jnp.int32)
+    for j in range(num_experts_per_tok):
+        onehot = jax.nn.one_hot(expert_idx[:, j], n_experts, dtype=jnp.int32)  # [N, E]
+        # position of each token within its expert's buffer, counting tokens
+        # already placed by earlier choices
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]  # [N, E]
+        counts = counts + jnp.sum(onehot, axis=0)
+        keep = (pos_in_expert < capacity) & (onehot > 0)  # [N, E]
+        pos = jnp.sum(jnp.where(keep, pos_in_expert, 0), axis=1)  # [N]
+        cap_onehot = jax.nn.one_hot(pos, capacity, dtype=router_probs.dtype)  # [N, C]
+        disp_j = keep.astype(router_probs.dtype)[:, :, None] * cap_onehot[:, None, :]
+        dispatch = dispatch + disp_j
+        combine = combine + gates[:, j][:, None, None] * disp_j
+
+    # Switch aux loss over top-1 assignments (Fedus et al. eq. 4)
+    top1 = jax.nn.one_hot(expert_idx[:, 0], n_experts, dtype=router_probs.dtype)
+    fraction_routed = jnp.mean(top1, axis=0)           # f_e
+    mean_prob = jnp.mean(router_probs, axis=0)         # P_e
+    aux_loss = n_experts * jnp.sum(fraction_routed * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MoE replacement for the dense MLP block (SwiGLU experts).
+
+    Expert weights stack on a leading ``[num_experts, ...]`` axis — shard it
+    over ``ep`` with :func:`shard_moe_params` and the dispatch einsums become
+    all-to-alls under GSPMD.
+    """
+
+    config: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, h = x.shape
+        n_tokens = b * s
+        xf = x.reshape(n_tokens, h)
+
+        # fp32 router (precision-sensitive; Switch recommendation)
+        router_logits = nn.Dense(
+            cfg.num_experts,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02),
+            name="router",
+        )(xf.astype(jnp.float32))
+        router_probs = jax.nn.softmax(router_logits, axis=-1)
+
+        capacity = cfg.resolved_expert_capacity(n_tokens)
+        dispatch, combine, aux_loss = top_k_dispatch(
+            router_probs, cfg.num_experts_per_tok, capacity
+        )
+        self.sow("intermediates", "router_aux_loss", aux_loss)
+
+        dispatch = dispatch.astype(cfg.dtype)
+        combine = combine.astype(cfg.dtype)
+        expert_in = jnp.einsum("nec,nh->ech", dispatch, xf.astype(cfg.dtype))
+
+        from ..models.transformer import MLP
+
+        ExpertMLP = nn.vmap(
+            MLP,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=0,
+            out_axes=0,
+            axis_size=cfg.num_experts,
+        )
+        expert_out = ExpertMLP(cfg, name="experts")(expert_in)  # [E, C, H]
+        y = jnp.einsum("nec,ech->nh", combine, expert_out.astype(cfg.dtype))
+        return y.reshape(b, s, h).astype(x.dtype)
+
+
+def shard_moe_params(params, mesh: Mesh, axis: str = "ep", marker: str = "experts"):
+    """Shard stacked expert weights over ``mesh[axis]`` (leading expert dim);
+    everything else replicated over that axis.  Composes with tp/fsdp rules by
+    running them first and this one after (it only touches expert leaves)."""
+    from .tensor_parallel import path_to_str
+
+    ep = mesh.shape.get(axis, 1)
+
+    def place(path, x):
+        p = path_to_str(path)
+        if marker in p.split("/") and hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] % ep == 0:
+            spec = [axis] + [None] * (x.ndim - 1)
+            return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+        return x
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def router_aux_loss(intermediates, coef: float) -> jax.Array:
+    """Sum sown ``router_aux_loss`` values * coef (trainer-side hook)."""
+    total = jnp.float32(0.0)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        last = path[-1]
+        name = getattr(last, "key", getattr(last, "name", None))
+        # sown values arrive as tuples under the 'router_aux_loss' key
+        if name == "router_aux_loss" or any(
+            getattr(p, "key", getattr(p, "name", None)) == "router_aux_loss" for p in path
+        ):
+            total = total + jnp.sum(leaf)
+    return coef * total
