@@ -89,6 +89,20 @@ class LocalSGD:
 
     def _stack(self, tree):
         n = self.num_replicas
+        if self.enabled:
+            # Stacking broadcasts every leaf to a (dp, ...) stack sharded only
+            # over the replica axis — any fsdp/tp sharding on the incoming
+            # state would be silently discarded, fully replicating the model
+            # per replica and blowing up per-device memory. Refuse it.
+            for leaf in jax.tree_util.tree_leaves(tree):
+                sharding = getattr(leaf, "sharding", None)
+                spec = getattr(sharding, "spec", None)
+                if spec is not None and any(axis is not None for axis in spec):
+                    raise ValueError(
+                        "LocalSGD supports pure data-parallel (replicated) states "
+                        f"only; got a leaf sharded with spec {spec}. Prepare the "
+                        "TrainState without fsdp/tp sharding to use LocalSGD."
+                    )
 
         def tile(x):
             x = jnp.asarray(x)

@@ -30,22 +30,28 @@ _OOM_MARKERS = (
 )
 
 
-def release_memory(*objects):
-    """Delete device buffers and collect garbage (reference ``utils/memory.py:29-66``).
+def release_memory(*objects, force_delete: bool = False):
+    """Drop references and collect garbage (reference ``utils/memory.py:29-66``).
 
-    JAX arrays hold HBM until the Python reference dies *and* the buffer is
-    deleted; ``jax.Array.delete()`` frees eagerly.  Returns a ``None`` for every
-    input so callers can rebind: ``a, b = release_memory(a, b)``.
+    Returns a ``None`` for every input so callers can rebind:
+    ``a, b = release_memory(a, b)``.  Like the reference (which only drops
+    references and empties the cache), buffers are freed when the last Python
+    reference dies — aliases held elsewhere (a TrainState holding the same
+    params tree, a donated copy) stay valid.
+
+    ``force_delete=True`` additionally calls ``jax.Array.delete()`` on every
+    leaf, freeing HBM eagerly; only use it when the passed trees are
+    exclusively owned, since it invalidates *all* references to those buffers.
     """
     import jax
 
     if not isinstance(objects, list):
         objects = list(objects)
     for i in range(len(objects)):
-        obj = objects[i]
-        for leaf in jax.tree_util.tree_leaves(obj):
-            if isinstance(leaf, jax.Array) and not leaf.is_deleted():
-                leaf.delete()
+        if force_delete:
+            for leaf in jax.tree_util.tree_leaves(objects[i]):
+                if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+                    leaf.delete()
         objects[i] = None
     gc.collect()
     return objects

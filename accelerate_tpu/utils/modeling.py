@@ -36,7 +36,9 @@ def _leaf_nbytes(leaf, dtype=None) -> int:
 
 
 def flatten_tree(tree, prefix: str = "") -> Dict[str, Any]:
-    """{'layers_0/attn/q_proj/kernel': leaf} — flax param tree to flat paths."""
+    """{'layers_0.attn.q_proj.kernel': leaf} — flax param tree to flat
+    dot-separated paths (the checkpoint/safetensors key convention; distinct
+    from the '/'-separated rule paths used by ``parallel.tensor_parallel``)."""
     flat: Dict[str, Any] = {}
     if isinstance(tree, dict):
         for key, value in tree.items():
@@ -99,6 +101,36 @@ def top_level_modules(tree: PathTree) -> List[str]:
     return sorted(tree.keys(), key=natkey)
 
 
+def get_max_memory(
+    num_devices: Optional[int] = None, reserve_fraction: float = 0.1
+) -> Optional[Dict[DeviceId, int]]:
+    """Real per-device HBM budgets from runtime memory stats (reference
+    ``get_max_memory``, ``utils/modeling.py:793-866``, which reads actual free
+    device memory).
+
+    Returns ``None`` when the backend exposes no ``memory_stats()`` (e.g. the
+    CPU platform used in tests) — callers then fall back to a synthetic even
+    split.  ``reserve_fraction`` of the limit is held back for activations and
+    XLA scratch.
+    """
+    devices = jax.devices()
+    n = num_devices if num_devices is not None else len(devices)
+    budgets: Dict[DeviceId, int] = {}
+    for i in range(n):
+        if i >= len(devices):
+            return None
+        try:
+            stats = devices[i].memory_stats()
+        except Exception:
+            return None
+        limit = (stats or {}).get("bytes_limit")
+        if not limit:
+            return None
+        in_use = (stats or {}).get("bytes_in_use", 0)
+        budgets[i] = max(int((limit - in_use) * (1.0 - reserve_fraction)), 0)
+    return budgets
+
+
 def get_balanced_memory(
     tree: PathTree,
     max_memory: Optional[Dict[DeviceId, int]] = None,
@@ -109,7 +141,15 @@ def get_balanced_memory(
     """Even per-device budgets (reference ``get_balanced_memory``,
     ``utils/modeling.py:952-1075``): spread the model across devices instead of
     greedily filling device 0.  ``low_zero`` leaves device 0 mostly free (the
-    reference's ``balanced_low_0`` for generate() workloads)."""
+    reference's ``balanced_low_0`` for generate() workloads).
+
+    When the runtime exposes real HBM stats (:func:`get_max_memory`), the even
+    split is clamped to each device's actual free memory, so a model larger
+    than total HBM spills to cpu/disk — the case auto device maps exist for.
+    On backends without memory stats the split is synthetic and always fits the
+    whole model on devices; pass explicit ``max_memory`` there to exercise
+    spill behavior.
+    """
     if max_memory is not None:
         return dict(max_memory)
     n = num_devices if num_devices is not None else len(jax.devices())
@@ -117,9 +157,12 @@ def get_balanced_memory(
     max_layer, _ = get_max_layer_size(tree, dtype=dtype)
     active = n - 1 if (low_zero and n > 1) else n
     per_device = total // max(active, 1) + max_layer
-    budgets: Dict[DeviceId, int] = {i: per_device for i in range(n)}
+    real = get_max_memory(n)
+    budgets: Dict[DeviceId, int] = {
+        i: per_device if real is None else min(per_device, real[i]) for i in range(n)
+    }
     if low_zero and n > 1:
-        budgets[0] = max_layer
+        budgets[0] = max_layer if real is None else min(max_layer, real[0])
     budgets["cpu"] = 10**15
     budgets["disk"] = 10**18
     return budgets

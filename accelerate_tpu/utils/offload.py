@@ -27,8 +27,9 @@ def offload_weight(weight, weight_name: str, offload_folder: str, index: Optiona
         # np.memmap has no bf16; store the raw bytes as int16 (reference stores
         # torch bf16 as int16 the same way, utils/offload.py:37-41)
         weight = weight.view(np.int16)
+    # weight names are dot-separated tree paths ("layers_0.attn....") → flat
+    # files under offload_folder; '/'-separated names still get nested dirs
     array_path = os.path.join(offload_folder, f"{weight_name}.dat")
-    # weight names are tree paths ("layers_0/attn/...") → nested dirs
     os.makedirs(os.path.dirname(array_path), exist_ok=True)
     file_array = np.memmap(array_path, dtype=weight.dtype, mode="w+", shape=weight.shape or (1,))
     if weight.shape == ():
