@@ -502,6 +502,45 @@ class Accelerator:
             opt_rule = lambda path, x: shape_opt_rule(x)
         replicated = NamedSharding(self.mesh, PartitionSpec())
 
+        ep_size = mesh_lib.mesh_axis_size(self.mesh, "ep")
+        if ep_size > 1:
+            # Stacked-expert leaves ([num_experts, ...], module name "experts")
+            # shard their leading dim over ep; the dispatch/combine einsums then
+            # lower to all-to-alls under GSPMD (parallel/moe.py design).
+            from .parallel.sharding import expert_partition_spec
+            from .parallel.tensor_parallel import path_to_str
+
+            fsdp_size = mesh_lib.mesh_axis_size(self.mesh, "fsdp")
+            min_size = plugin.min_weight_size if plugin is not None else 2**12
+
+            def _expert_wrap(base, shards_fsdp: bool):
+                # fsdp composition honors the strategy's shards flag, exactly
+                # like the base shape rules do
+                eff_fsdp = fsdp_size if shards_fsdp else 1
+
+                def wrapped(path, x):
+                    base_sharding = base(path, x)
+                    if "experts" in path_to_str(path).split("/"):
+                        spec = expert_partition_spec(
+                            getattr(x, "shape", ()), ep_size, eff_fsdp, min_size
+                        )
+                        # keep the base rule's memory kind (host offload applies
+                        # to expert leaves like any other param/opt leaf)
+                        kind = getattr(base_sharding, "memory_kind", None)
+                        if kind is not None and kind != "device":
+                            return NamedSharding(self.mesh, spec, memory_kind=kind)
+                        return NamedSharding(self.mesh, spec)
+                    return base_sharding
+
+                return wrapped
+
+            param_rule = _expert_wrap(
+                param_rule, plugin is not None and plugin.shards_params
+            )
+            opt_rule = _expert_wrap(
+                opt_rule, plugin is not None and plugin.shards_opt_state
+            )
+
         def rule(path, x):
             root = path[0]
             name = getattr(root, "name", getattr(root, "key", None))

@@ -46,10 +46,24 @@ class TransformerConfig:
     scan_layers: bool = False          # roll layers into lax.scan
     attention_impl: str = "xla"        # "xla" | "pallas" | "ring"
     dropout_rate: float = 0.0
+    # Mixture-of-Experts (num_experts == 0 -> dense MLP).  Reference MoE surface
+    # is DeepSpeed passthrough only (utils/dataclasses.py:792-798); here experts
+    # are a first-class stacked axis sharded over the ``ep`` mesh axis.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 2.0
+    router_aux_loss_coef: float = 0.01
 
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    def resolved_expert_capacity(self, n_tokens: int) -> int:
+        """Per-expert token buffer: factor * even-split share, rounded up to a
+        multiple of 8 (TPU sublane tiling; keeps the dispatch einsum MXU-friendly)."""
+        even = n_tokens * self.num_experts_per_tok / max(self.num_experts, 1)
+        cap = int(-(-self.expert_capacity_factor * even // 1))
+        return max(8, -(-cap // 8) * 8)
 
     @classmethod
     def llama2_7b(cls, **kw):
@@ -69,6 +83,13 @@ class TransformerConfig:
         return cls(**{**dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                              num_layers=2, num_heads=4, num_kv_heads=2,
                              max_seq_len=128), **kw})
+
+    @classmethod
+    def tiny_moe(cls, **kw):
+        """Test-sized MoE variant (ep-sharding tests, dry-runs)."""
+        return cls(**{**dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                             num_layers=2, num_heads=4, num_kv_heads=2,
+                             max_seq_len=128, num_experts=4, num_experts_per_tok=2), **kw})
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -154,9 +175,13 @@ class DecoderLayer(nn.Module):
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x), positions
         )
-        x = x + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x)
-        )
+        if cfg.num_experts > 0:
+            from ..parallel.moe import MoEMLP
+
+            mlp = MoEMLP(cfg, name="moe_mlp")
+        else:
+            mlp = MLP(cfg, name="mlp")
+        x = x + mlp(RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x))
         return x
 
 
@@ -190,7 +215,9 @@ class Transformer(nn.Module):
                 body = nn.remat(ScanBody, prevent_cse=False)
             ScanLayers = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                # intermediates must be scanned too, or sown values (MoE router
+                # aux loss) are silently dropped inside the scan body
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 in_axes=(nn.broadcast,),
@@ -242,13 +269,32 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100, z_loss: float =
 
 
 def lm_loss_fn(model: Transformer):
-    """Standard next-token loss for ``Accelerator.compile_train_step``."""
+    """Standard next-token loss for ``Accelerator.compile_train_step``.
+
+    For MoE configs the Switch router aux loss (sown as an intermediate) is
+    added with ``router_aux_loss_coef`` — the load-balancing term the reference
+    leaves to DeepSpeed's engine.
+    """
+    cfg = model.config
+    is_moe = cfg.num_experts > 0 and cfg.router_aux_loss_coef > 0.0
 
     def loss_fn(params, batch, rng=None):
-        logits = model.apply({"params": params}, batch["input_ids"])
+        if is_moe:
+            logits, mutables = model.apply(
+                {"params": params}, batch["input_ids"], mutable=["intermediates"]
+            )
+        else:
+            logits = model.apply({"params": params}, batch["input_ids"])
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-        return cross_entropy_loss(logits, labels)
+        loss = cross_entropy_loss(logits, labels)
+        if is_moe:
+            from ..parallel.moe import router_aux_loss
+
+            loss = loss + router_aux_loss(
+                mutables["intermediates"], cfg.router_aux_loss_coef
+            )
+        return loss
 
     return loss_fn

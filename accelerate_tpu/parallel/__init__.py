@@ -1,5 +1,6 @@
 """Parallelism substrate: named meshes, sharding rules, collectives, model parallel."""
 
+from .moe import MoEMLP, router_aux_loss, shard_moe_params, top_k_dispatch
 from .pipeline import pipeline_apply, prepare_pipeline, stack_layer_params
 from .ring_attention import ring_attention, ring_attention_sharded
 from .mesh import (

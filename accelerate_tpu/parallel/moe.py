@@ -116,19 +116,27 @@ class MoEMLP(nn.Module):
         return y.reshape(b, s, h).astype(x.dtype)
 
 
-def shard_moe_params(params, mesh: Mesh, axis: str = "ep", marker: str = "experts"):
-    """Shard stacked expert weights over ``mesh[axis]`` (leading expert dim);
-    everything else replicated over that axis.  Composes with tp/fsdp rules by
-    running them first and this one after (it only touches expert leaves)."""
+def shard_moe_params(params, mesh: Mesh, *, marker: str = "experts"):
+    """Shard stacked expert weights over the mesh's ``ep`` axis (leading expert
+    dim, composed with ``fsdp`` on the largest remaining dim); non-expert leaves
+    are left untouched.  No-op on meshes without an ``ep`` axis of size > 1.
+
+    This is the standalone form of the placement the :class:`Accelerator`
+    applies automatically in ``create_train_state`` — both delegate to
+    :func:`..parallel.sharding.expert_partition_spec` for the actual spec.
+    """
+    from .sharding import expert_partition_spec
     from .tensor_parallel import path_to_str
 
-    ep = mesh.shape.get(axis, 1)
+    ep = mesh.shape.get("ep", 1)
+    if ep <= 1:
+        return params
+    fsdp = mesh.shape.get("fsdp", 1)
 
     def place(path, x):
-        p = path_to_str(path)
-        if marker in p.split("/") and hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] % ep == 0:
-            spec = [axis] + [None] * (x.ndim - 1)
-            return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+        if marker in path_to_str(path).split("/") and hasattr(x, "shape"):
+            spec = expert_partition_spec(x.shape, ep, fsdp)
+            return jax.device_put(x, NamedSharding(mesh, spec))
         return x
 
     return jax.tree_util.tree_map_with_path(place, params)

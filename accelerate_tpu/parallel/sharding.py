@@ -43,6 +43,37 @@ def fsdp_partition_spec(
     return PartitionSpec()
 
 
+def expert_partition_spec(
+    shape: Sequence[int],
+    ep_size: int,
+    fsdp_size: int = 1,
+    min_weight_size: int = 2**12,
+) -> PartitionSpec:
+    """Spec for stacked-expert kernels: expert dim over ``ep``, largest matmul
+    dim over ``fsdp`` when large enough — expert parallelism composed with
+    ZeRO-style intra-expert sharding.
+
+    Expert leaves are vmapped Dense kernels ``[E, in, out]``; under
+    ``nn.scan`` an extra layer axis stacks in front (``[L, E, in, out]``), so
+    like the TP rules the expert dim is anchored from the *trailing* matmul
+    dims: ``ndim - 3``.
+    """
+    if not shape or ep_size <= 1:
+        return fsdp_partition_spec(shape, fsdp_size, min_weight_size)
+    expert_dim = max(0, len(shape) - 3)
+    if shape[expert_dim] % ep_size != 0:
+        return fsdp_partition_spec(shape, fsdp_size, min_weight_size)
+    spec: list = [None] * len(shape)
+    spec[expert_dim] = "ep"
+    if fsdp_size > 1 and math.prod(shape) >= min_weight_size:
+        rest = sorted(range(expert_dim + 1, len(shape)), key=lambda d: shape[d], reverse=True)
+        for d in rest:
+            if shape[d] % fsdp_size == 0:
+                spec[d] = "fsdp"
+                break
+    return PartitionSpec(*spec)
+
+
 def make_param_sharding_fn(
     mesh: Mesh,
     plugin: Optional[FullyShardedDataParallelPlugin] = None,
