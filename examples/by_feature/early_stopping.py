@@ -1,0 +1,68 @@
+"""Feature: cross-process early stopping (reference
+`examples/by_feature/early_stopping.py`).
+
+When any process decides to stop (loss threshold, NaN guard, SIGTERM...), all
+processes must break on the same step or the collective program deadlocks.
+`accelerator.set_trigger()` raises a local flag; `accelerator.check_trigger()`
+all-reduces it so every process sees it and resets — the reference's flag-
+tensor handshake (`accelerator.py:2148-2205`), here over the mesh.
+
+Run:  python examples/by_feature/early_stopping.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, set_seed
+from nlp_example import EncoderClassifier, MAX_LEN, get_dataloaders
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int, default=10)
+    parser.add_argument("--loss_threshold", type=float, default=0.45)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(mesh={"dp": -1})
+    set_seed(42)
+    train_dl, _ = get_dataloaders(accelerator, batch_size=16)
+
+    model = EncoderClassifier()
+    params = model.init(jax.random.PRNGKey(42), jnp.zeros((1, MAX_LEN), jnp.int32))["params"]
+    state = accelerator.create_train_state(params=params, tx=optax.adamw(3e-4), seed=42)
+
+    def loss_fn(params, batch, rng=None):
+        logits = model.apply({"params": params}, batch["input_ids"])
+        return optax.softmax_cross_entropy(logits, jax.nn.one_hot(batch["labels"], 2)).mean()
+
+    step = accelerator.compile_train_step(loss_fn)
+
+    stopped = False
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            if float(metrics["loss"]) < args.loss_threshold:
+                accelerator.set_trigger()
+            # every process breaks together, or nobody does
+            if accelerator.check_trigger():
+                accelerator.print(
+                    f"early stop at epoch {epoch}, loss {float(metrics['loss']):.4f}"
+                )
+                stopped = True
+                break
+        if stopped:
+            break
+    if not stopped:
+        accelerator.print(f"ran all {args.num_epochs} epochs without triggering")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Feature: automatic OOM recovery (reference `examples/by_feature/memory.py`).
+
+`find_executable_batch_size` wraps the training function; if the device runs
+out of memory (XLA RESOURCE_EXHAUSTED), the decorator frees cached state and
+retries with the batch size halved, until training fits. The reference catches
+CUDA OOM strings; here the probe understands XLA/TPU allocator errors.
+
+This demo starts at an absurd batch size and injects a fake OOM for any batch
+size over 16, so the halving path is exercised deterministically on any host.
+
+Run:  python examples/by_feature/memory.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, find_executable_batch_size, set_seed
+from nlp_example import EncoderClassifier, MAX_LEN, get_dataloaders
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--starting_batch_size", type=int, default=128)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(mesh={"dp": -1})
+    set_seed(42)
+    model = EncoderClassifier()
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def training_function(batch_size):
+        accelerator.print(f"Trying batch size: {batch_size}")
+        if batch_size > 16:
+            # stand-in for a real device OOM so the demo works on any host;
+            # delete this line in real code — real RESOURCE_EXHAUSTED errors
+            # from XLA take exactly the same path
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1234567 bytes")
+        train_dl, _ = get_dataloaders(accelerator, batch_size)
+        params = model.init(jax.random.PRNGKey(42), jnp.zeros((1, MAX_LEN), jnp.int32))["params"]
+        state = accelerator.create_train_state(params=params, tx=optax.adamw(2e-4), seed=42)
+
+        def loss_fn(params, batch, rng=None):
+            logits = model.apply({"params": params}, batch["input_ids"])
+            return optax.softmax_cross_entropy(logits, jax.nn.one_hot(batch["labels"], 2)).mean()
+
+        step = accelerator.compile_train_step(loss_fn)
+        for _ in range(args.num_epochs):
+            for batch in train_dl:
+                state, metrics = step(state, batch)
+        accelerator.print(f"Trained at batch size {batch_size}: loss {float(metrics['loss']):.4f}")
+        return batch_size
+
+    final = training_function()
+    accelerator.print(f"Executable batch size found: {final}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Feature: experiment tracking (reference `examples/by_feature/tracking.py`).
+
+`Accelerator(log_with=...)` accepts any of the built-in trackers (tensorboard,
+wandb, comet_ml, aim, mlflow, clearml, dvclive, json) or "all" for every
+available one. `init_trackers` starts a run, `log` records metrics on the main
+process only, `end_training` flushes. The "json" tracker has no external
+dependency and writes `metrics.jsonl` — used here so the example runs anywhere.
+
+Run:  python examples/by_feature/tracking.py --project_dir /tmp/tracking_demo
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, ProjectConfiguration, set_seed
+from nlp_example import EncoderClassifier, MAX_LEN, get_dataloaders
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--project_dir", type=str, default="/tmp/tracking_demo")
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(
+        log_with="json",
+        project_config=ProjectConfiguration(project_dir=args.project_dir),
+        mesh={"dp": -1},
+    )
+    set_seed(42)
+    hps = {"num_epochs": args.num_epochs, "learning_rate": 2e-4, "batch_size": 16}
+    accelerator.init_trackers("tracking_example", config=hps)
+
+    train_dl, eval_dl = get_dataloaders(accelerator, batch_size=hps["batch_size"])
+    model = EncoderClassifier()
+    params = model.init(jax.random.PRNGKey(42), jnp.zeros((1, MAX_LEN), jnp.int32))["params"]
+    state = accelerator.create_train_state(
+        params=params, tx=optax.adamw(hps["learning_rate"]), seed=42
+    )
+
+    def loss_fn(params, batch, rng=None):
+        logits = model.apply({"params": params}, batch["input_ids"])
+        return optax.softmax_cross_entropy(logits, jax.nn.one_hot(batch["labels"], 2)).mean()
+
+    step = accelerator.compile_train_step(loss_fn)
+
+    def eval_fn(params, batch):
+        return jnp.argmax(model.apply({"params": params}, batch["input_ids"]), axis=-1)
+
+    eval_step = accelerator.compile_eval_step(eval_fn)
+
+    for epoch in range(args.num_epochs):
+        total_loss, n_batches = 0.0, 0
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            total_loss += float(metrics["loss"])
+            n_batches += 1
+        correct = total = 0
+        for batch in eval_dl:
+            preds, refs = accelerator.gather_for_metrics((eval_step(state.params, batch), batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += int(np.asarray(refs).shape[0])
+        accelerator.log(
+            {"train_loss": total_loss / max(n_batches, 1), "accuracy": correct / max(total, 1)},
+            step=epoch,
+        )
+        accelerator.print(f"epoch {epoch} logged")
+
+    accelerator.end_training()
+
+    metrics_file = os.path.join(args.project_dir, "tracking_example", "metrics.jsonl")
+    if accelerator.is_main_process and os.path.exists(metrics_file):
+        lines = [json.loads(l) for l in open(metrics_file)]
+        accelerator.print(f"tracker wrote {len(lines)} metric records to {metrics_file}")
+
+
+if __name__ == "__main__":
+    main()

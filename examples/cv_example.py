@@ -1,0 +1,139 @@
+"""Image classification — the framework's `cv_example`.
+
+TPU-native analog of the reference's ResNet/pets script
+(`/root/reference/examples/cv_example.py:1`): train a small CNN to classify
+procedurally rendered shapes (circle / square / cross) through the full
+`Accelerator` API. The reference downloads the Oxford-IIIT Pets dataset and a
+pretrained timm ResNet; this environment has no egress, so the dataset is
+generated deterministically in-process — the *training mechanics* (channels,
+normalization, schedule, distributed eval with `gather_for_metrics`) are the
+same, and the task is genuinely learnable so accuracy climbs to ~100%.
+
+TPU-first notes: NHWC layout (what XLA expects on TPU), static 32x32 shapes,
+bf16 compute via the mixed-precision policy, convs lower onto the MXU.
+
+Run:  python examples/cv_example.py [--mixed_precision bf16]
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, SimpleDataLoader, set_seed
+
+IMAGE_SIZE = 32
+NUM_CLASSES = 3
+
+
+def render_shape(kind: int, rng: np.random.Generator) -> np.ndarray:
+    """Draw one 32x32 grayscale image containing a circle, square or cross at a
+    random position/size, with noise — a deterministic, learnable stand-in for
+    a real image folder."""
+    img = rng.normal(0.0, 0.08, size=(IMAGE_SIZE, IMAGE_SIZE)).astype(np.float32)
+    cx, cy = rng.integers(10, IMAGE_SIZE - 10, size=2)
+    r = int(rng.integers(4, 8))
+    yy, xx = np.mgrid[0:IMAGE_SIZE, 0:IMAGE_SIZE]
+    if kind == 0:  # circle
+        img[(yy - cy) ** 2 + (xx - cx) ** 2 <= r * r] += 1.0
+    elif kind == 1:  # square
+        img[max(cy - r, 0):cy + r, max(cx - r, 0):cx + r] += 1.0
+    else:  # cross
+        img[max(cy - r, 0):cy + r, cx - 1:cx + 2] += 1.0
+        img[cy - 1:cy + 2, max(cx - r, 0):cx + r] += 1.0
+    return img[..., None]  # NHWC with one channel
+
+
+def make_dataset(n: int, seed: int) -> list:
+    rng = np.random.default_rng(seed)
+    return [
+        {"image": render_shape(k, rng), "label": np.int32(k)}
+        for k in rng.integers(0, NUM_CLASSES, size=n)
+    ]
+
+
+class SmallCNN(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        for features in (16, 32):
+            x = nn.Conv(features, (3, 3), name=f"conv_{features}")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64, name="fc1")(x))
+        return nn.Dense(NUM_CLASSES, name="head")(x)
+
+
+def training_function(config, args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, mesh={"dp": -1})
+    lr, num_epochs, seed, batch_size = (
+        config["lr"], int(config["num_epochs"]), int(config["seed"]), int(config["batch_size"]),
+    )
+    set_seed(seed)
+
+    train_dl = accelerator.prepare(
+        SimpleDataLoader(make_dataset(512, seed), batch_size=batch_size, shuffle=True, seed=seed)
+    )
+    eval_dl = accelerator.prepare(SimpleDataLoader(make_dataset(128, seed + 1), batch_size=batch_size))
+
+    model = SmallCNN()
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 1))
+    )["params"]
+
+    # normalize with dataset statistics (the reference normalizes with the
+    # pretrained model's mean/std)
+    sample = np.stack([r["image"] for r in make_dataset(256, seed)])
+    mean, std = float(sample.mean()), float(sample.std())
+
+    steps_per_epoch = len(train_dl)
+    schedule = optax.cosine_onecycle_schedule(
+        transition_steps=max(2, steps_per_epoch * num_epochs), peak_value=lr
+    )
+    state = accelerator.create_train_state(params=params, tx=optax.adam(schedule), seed=seed)
+
+    def loss_fn(params, batch, rng=None):
+        logits = model.apply({"params": params}, (batch["image"] - mean) / std)
+        onehot = jax.nn.one_hot(batch["label"], NUM_CLASSES)
+        return optax.softmax_cross_entropy(logits, onehot).mean()
+
+    train_step = accelerator.compile_train_step(loss_fn)
+
+    def eval_fn(params, batch):
+        logits = model.apply({"params": params}, (batch["image"] - mean) / std)
+        return jnp.argmax(logits, axis=-1)
+
+    eval_step = accelerator.compile_eval_step(eval_fn)
+
+    accuracy = 0.0
+    for epoch in range(num_epochs):
+        for batch in train_dl:
+            state, metrics = train_step(state, batch)
+
+        correct = total = 0
+        for batch in eval_dl:
+            predictions = eval_step(state.params, batch)
+            predictions, references = accelerator.gather_for_metrics((predictions, batch["label"]))
+            correct += int((np.asarray(predictions) == np.asarray(references)).sum())
+            total += int(np.asarray(references).shape[0])
+        accuracy = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: {100 * accuracy:.2f}")
+    accelerator.end_training()
+    return accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Simple CV training example.")
+    parser.add_argument("--mixed_precision", type=str, default=None, choices=["no", "fp16", "bf16"])
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=64)
+    args = parser.parse_args()
+    config = {"lr": 3e-3, "num_epochs": args.num_epochs, "seed": 42, "batch_size": args.batch_size}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Smoke-run every example script (reference tests/test_examples.py runs each
+by_feature script; here each runs as a subprocess on the 8-device CPU mesh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def run_example(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=8"])
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_nlp_example():
+    out = run_example("nlp_example.py", "--num_epochs", "1")
+    assert "epoch 0" in out
+
+
+def test_nlp_example_fsdp_bf16():
+    out = run_example("nlp_example.py", "--num_epochs", "1", "--fsdp", "--mixed_precision", "bf16")
+    assert "epoch 0" in out
+
+
+def test_cv_example():
+    out = run_example("cv_example.py", "--num_epochs", "1", "--batch_size", "32")
+    assert "epoch 0" in out
+
+
+def test_complete_nlp_example_checkpoint_and_resume(tmp_path):
+    out = run_example(
+        "complete_nlp_example.py", "--num_epochs", "1",
+        "--checkpointing_steps", "epoch", "--with_tracking",
+        "--project_dir", str(tmp_path),
+    )
+    assert "epoch 0" in out
+    assert (tmp_path / "epoch_0").is_dir()
+    out = run_example(
+        "complete_nlp_example.py", "--num_epochs", "2",
+        "--resume_from_checkpoint", str(tmp_path / "epoch_0"),
+        "--project_dir", str(tmp_path),
+    )
+    assert "Resuming" in out and "epoch 1" in out and "epoch 0:" not in out
+
+
+def test_feature_gradient_accumulation():
+    out = run_example("by_feature/gradient_accumulation.py", "--num_epochs", "1")
+    assert "optimizer_steps" in out
+
+
+def test_feature_checkpointing(tmp_path):
+    out = run_example("by_feature/checkpointing.py", "--project_dir", str(tmp_path))
+    assert "resumed epoch 1" in out
+
+
+def test_feature_tracking(tmp_path):
+    out = run_example("by_feature/tracking.py", "--project_dir", str(tmp_path), "--num_epochs", "1")
+    assert "metric records" in out
+
+
+def test_feature_memory():
+    out = run_example("by_feature/memory.py")
+    assert "Executable batch size found: 16" in out
+
+
+def test_feature_local_sgd():
+    out = run_example("by_feature/local_sgd.py", "--num_epochs", "1")
+    assert "optimizer step" in out
+
+
+def test_feature_early_stopping():
+    out = run_example("by_feature/early_stopping.py", "--num_epochs", "8")
+    assert "early stop" in out or "without triggering" in out
