@@ -196,6 +196,18 @@ class Accelerator:
         self._save_model_state_pre_hooks: Dict[Any, Callable] = {}
         self._load_model_state_pre_hooks: Dict[Any, Callable] = {}
         self._jit_cache: Dict[Any, Callable] = {}
+        # Most recent TrainState this accelerator created or stepped — the handle
+        # AcceleratedOptimizer.state_dict()/load_state_dict() round-trips through.
+        # _latest_state_by_tx disambiguates multiple optimizers: states are also
+        # keyed by the identity of their optax transformation.
+        self._latest_state: Optional[TrainState] = None
+        self._latest_state_by_tx: Dict[int, TrainState] = {}
+
+    def _track_state(self, state: TrainState) -> TrainState:
+        self._latest_state = state
+        if getattr(state, "tx", None) is not None:
+            self._latest_state_by_tx[id(state.tx)] = state
+        return state
 
     # --------------------------------------------------------------- topology
     def _default_mesh(self):
@@ -410,7 +422,7 @@ class Accelerator:
             self._dataloaders.append(prepared)
             return prepared
         if _is_optimizer_like(obj):
-            prepared = AcceleratedOptimizer(obj)
+            prepared = AcceleratedOptimizer(obj, _accelerator=self)
             self._optimizers.append(prepared)
             return prepared
         if isinstance(obj, TrainState):
@@ -485,7 +497,7 @@ class Accelerator:
 
         abstract = jax.eval_shape(init_fn, params)
         shardings = self._train_state_shardings(abstract)
-        return self._place_with_offload(init_fn, params, shardings)
+        return self._track_state(self._place_with_offload(init_fn, params, shardings))
 
     def _train_state_shardings(self, abstract_state):
         plugin = self.effective_fsdp_plugin
@@ -541,6 +553,11 @@ class Accelerator:
                 opt_rule, plugin is not None and plugin.shards_opt_state
             )
 
+        # ZeRO-1 vs ZeRO-2: stage 1 keeps the grad buffer replicated like the
+        # params (all-reduce comm pattern); stage 2+ shards it over fsdp so XLA
+        # reduce-scatters instead (FullyShardedDataParallelPlugin.shards_grads).
+        grad_rule = opt_rule if (plugin is None or plugin.shards_grads) else param_rule
+
         def rule(path, x):
             root = path[0]
             name = getattr(root, "name", getattr(root, "key", None))
@@ -551,7 +568,7 @@ class Accelerator:
             if name == "grad_accum":
                 # grads are touched every micro-step: keep them in HBM even when
                 # the optimizer state is host-offloaded
-                return _strip_memory_kind(opt_rule(path, x))
+                return _strip_memory_kind(grad_rule(path, x))
             return replicated
 
         return jax.tree_util.tree_map_with_path(rule, abstract_state)
@@ -559,7 +576,7 @@ class Accelerator:
     def _shard_train_state(self, state: TrainState) -> TrainState:
         abstract = jax.eval_shape(lambda s: s, state)
         shardings = self._train_state_shardings(abstract)
-        return self._place_with_offload(lambda s: s, state, shardings)
+        return self._track_state(self._place_with_offload(lambda s: s, state, shardings))
 
     def _place_with_offload(self, init_fn, operand, shardings):
         """jit into device shardings, then move host-offloaded leaves out of HBM.
@@ -775,6 +792,7 @@ class Accelerator:
                 (gs.sync_with_dataloader and gs.end_of_dataloader) or gs.sync_each_batch
             )
             new_state, metrics = jitted(state, batch, force)
+            self._track_state(new_state)
             # python-side GradientState mirror (reference _do_sync, accelerator.py:1001-1008);
             # a forced sync resets the counter so it stays aligned with micro_step.
             self.step += 1
@@ -894,7 +912,7 @@ class Accelerator:
                     return state.replace(micro_step=state.micro_step + 1, rng=new_rng)
 
                 self._jit_cache[key] = jax.jit(_acc, donate_argnums=() if offloading else (0,))
-            return self._jit_cache[key](state, grads)
+            return self._track_state(self._jit_cache[key](state, grads))
         key = ("apply_grads", max_grad_norm)
         if key not in self._jit_cache:
             def _apply(state, grads):
@@ -937,7 +955,7 @@ class Accelerator:
                 return new.replace(micro_step=jnp.zeros((), jnp.int32))
 
             self._jit_cache[key] = jax.jit(_apply, donate_argnums=() if offloading else (0,))
-        return self._jit_cache[key](state, grads)
+        return self._track_state(self._jit_cache[key](state, grads))
 
     def clip_grad_norm_(self, grads, max_norm: float, norm_type: float = 2.0):
         """Clip a gradient pytree by global norm (reference ``accelerator.py:2242-2289``)."""
@@ -1024,6 +1042,8 @@ class Accelerator:
     def free_memory(self, *objects):
         """Release compiled/jitted caches and live buffers (reference ``accelerator.py:3158``)."""
         self._jit_cache.clear()
+        self._latest_state = None
+        self._latest_state_by_tx.clear()
         self._models.clear()
         self._optimizers.clear()
         self._schedulers.clear()

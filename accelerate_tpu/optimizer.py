@@ -26,6 +26,7 @@ class AcceleratedOptimizer:
         self,
         optimizer: Union[optax.GradientTransformation, "AcceleratedOptimizer"],
         scheduler: Optional[Callable[[int], float]] = None,
+        _accelerator=None,
     ):
         if isinstance(optimizer, AcceleratedOptimizer):
             optimizer = optimizer.optimizer
@@ -39,6 +40,7 @@ class AcceleratedOptimizer:
         self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
         self._step_was_skipped = False
         self._accumulated = None  # imperative-mode grad buffer
+        self._accelerator = _accelerator  # link to the live TrainState for state_dict()
 
     # ------------------------------------------------------------- optax API
     def init(self, params):
@@ -61,7 +63,87 @@ class AcceleratedOptimizer:
         """No-op for parity: grads are function outputs, never module state."""
         self._accumulated = None
 
+    def _resolve_state(self):
+        """The TrainState this wrapper's state lives in (reference contract:
+        optimizer objects *hold* their state; here it flows through the step fn,
+        so the linked Accelerator tracks the most recent state it produced).
+
+        States are keyed by the identity of their optax transformation so that
+        with several prepared optimizers each wrapper resolves its *own* state;
+        the plain latest-state fallback only applies when that key was never
+        seen (e.g. the TrainState was built with a re-wrapped transformation).
+        States stepped outside accelerator APIs (a hand-rolled jax.jit loop)
+        are invisible here — use accelerator.save_state() for those.
+        """
+        if self._accelerator is not None:
+            by_tx = getattr(self._accelerator, "_latest_state_by_tx", {})
+            state = by_tx.get(id(self.optimizer))
+            if state is None and len(by_tx) <= 1:
+                state = getattr(self._accelerator, "_latest_state", None)
+        else:
+            state = None
+        if state is None:
+            raise RuntimeError(
+                "No TrainState is linked to this optimizer yet. Create one with "
+                "accelerator.create_train_state(tx=this_optimizer) (or run a prepared "
+                "step) before calling state_dict()/load_state_dict(), or use "
+                "accelerator.save_state()/load_state() directly."
+            )
+        return state
+
     def state_dict(self):
-        raise NotImplementedError(
-            "Optimizer state lives in the TrainState pytree; use accelerator.save_state()."
+        """Host-side snapshot of the optimizer state (reference ``optimizer.py:98-104``).
+
+        Returns the optax state pytree as numpy plus the applied-step counters;
+        round-trips through :meth:`load_state_dict`.
+        """
+        import numpy as np
+
+        state = self._resolve_state()
+        # single batched D2H transfer of the whole pytree (not per-leaf round-trips)
+        host_opt = jax.tree_util.tree_map(np.asarray, jax.device_get(state.opt_state))
+        sd: dict = {
+            "opt_state": host_opt,
+            "step": int(jax.device_get(state.step)),
+            "micro_step": int(jax.device_get(state.micro_step)),
+        }
+        if state.loss_scale is not None:
+            sd["loss_scale"] = {
+                "scale": float(jax.device_get(state.loss_scale.scale)),
+                "growth_tracker": int(jax.device_get(state.loss_scale.growth_tracker)),
+            }
+        return sd
+
+    def load_state_dict(self, state_dict) -> None:
+        """Restore a :meth:`state_dict` snapshot into the linked TrainState.
+
+        The updated state becomes the Accelerator's current state; functional-style
+        users can instead call :meth:`restore` to get the new TrainState explicitly.
+        """
+        new_state = self.restore(self._resolve_state(), state_dict)
+        self._accelerator._track_state(new_state)
+
+    def restore(self, state, state_dict):
+        """Pure version of :meth:`load_state_dict`: returns ``state`` with the
+        snapshot's optimizer state/counters placed back onto each leaf's sharding."""
+
+        def place(cur, val):
+            if isinstance(cur, jax.Array):
+                return jax.device_put(jnp.asarray(val, dtype=cur.dtype), cur.sharding)
+            return val
+
+        new_opt = jax.tree_util.tree_map(place, state.opt_state, state_dict["opt_state"])
+        new_state = state.replace(
+            opt_state=new_opt,
+            step=jnp.asarray(state_dict.get("step", 0), dtype=jnp.int32),
+            micro_step=jnp.asarray(state_dict.get("micro_step", 0), dtype=jnp.int32),
         )
+        ls = state_dict.get("loss_scale")
+        if ls is not None and state.loss_scale is not None:
+            new_state = new_state.replace(
+                loss_scale=state.loss_scale.replace(
+                    scale=jnp.asarray(ls["scale"], jnp.float32),
+                    growth_tracker=jnp.asarray(ls["growth_tracker"], jnp.int32),
+                )
+            )
+        return new_state

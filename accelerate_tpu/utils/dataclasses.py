@@ -314,6 +314,11 @@ class FullyShardedDataParallelPlugin:
     use_orig_params: bool = True             # parity no-op: params are never flattened
     sync_module_states: bool = True          # parity no-op: init is deterministic/global
     activation_checkpointing: bool = False   # apply jax.checkpoint to each layer
+    # ZeRO-1 vs ZeRO-2 distinction: whether the gradient (accumulation) buffer is
+    # sharded over the fsdp axis alongside the optimizer state.  None derives it
+    # from the strategy (sharded whenever opt state is — the ZeRO-2/FSDP default);
+    # ZeroPlugin(stage=1) sets False so grads stay replicated like the params.
+    shard_gradients: Optional[bool] = None
 
     def __post_init__(self):
         if isinstance(self.sharding_strategy, str):
@@ -343,6 +348,12 @@ class FullyShardedDataParallelPlugin:
     @property
     def shards_opt_state(self) -> bool:
         return self.sharding_strategy != ShardingStrategy.NO_SHARD
+
+    @property
+    def shards_grads(self) -> bool:
+        if self.shard_gradients is not None:
+            return self.shard_gradients
+        return self.shards_opt_state
 
     @property
     def hybrid(self) -> bool:
@@ -384,10 +395,15 @@ class ZeroPlugin:
             raise ValueError(f"ZeRO stage must be 0-3, got {self.zero_stage}")
 
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
-        """Lower the ZeRO description onto the single sharding mechanism."""
+        """Lower the ZeRO description onto the single sharding mechanism.
+
+        Stage 1 shards only the optimizer state (grads stay replicated and are
+        all-reduced); stage 2 additionally shards the gradient buffer, so XLA
+        reduce-scatters grads instead — the reference stages' exact comm split.
+        """
         strategy = {
             0: ShardingStrategy.NO_SHARD,
-            1: ShardingStrategy.SHARD_GRAD_OP,  # opt-state sharded; grads reduced-scattered
+            1: ShardingStrategy.SHARD_GRAD_OP,
             2: ShardingStrategy.SHARD_GRAD_OP,
             3: ShardingStrategy.FULL_SHARD,
         }[self.zero_stage]
@@ -396,6 +412,7 @@ class ZeroPlugin:
             min_weight_size=0 if self.zero_stage == 3 else 2**12,
             cpu_offload=self.offload_param_device in ("cpu", "nvme"),
             offload_optimizer=self.offload_optimizer_device in ("cpu", "nvme"),
+            shard_gradients=self.zero_stage >= 2,
         )
 
 

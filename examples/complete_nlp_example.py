@@ -84,8 +84,10 @@ def training_function(config, args):
         total_loss = 0.0
         epoch_dl = train_dl
         if resume_step is not None:
-            # mid-epoch resume: fast-forward the loader past trained batches
+            # mid-epoch resume: fast-forward the loader past trained batches and
+            # advance the global counter so step_N checkpoint names stay aligned
             epoch_dl = skip_first_batches(train_dl, resume_step)
+            overall_step += resume_step
             resume_step = None
         for batch in epoch_dl:
             state, metrics = train_step(state, batch)

@@ -90,6 +90,61 @@ class TestZeroMapping:
         with pytest.raises(ValueError):
             ZeroPlugin(zero_stage=5)
 
+    @pytest.mark.parametrize("stage,shards_grads", [(0, False), (1, False), (2, True), (3, True)])
+    def test_stage_gradient_sharding(self, stage, shards_grads):
+        # ZeRO-1 shards only opt state (grads all-reduced); ZeRO-2 also shards
+        # the gradient buffer (reduce-scatter comm pattern).
+        fsdp = ZeroPlugin(zero_stage=stage).to_fsdp_plugin()
+        assert fsdp.shards_grads == shards_grads
+
+    @pytest.mark.parametrize("stage", [1, 2])
+    def test_grad_accum_buffer_sharding_differs_by_stage(self, stage):
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(
+            deepspeed_plugin=ZeroPlugin(zero_stage=stage),
+            gradient_accumulation_steps=2,
+        )
+        state = acc.create_train_state(params={"w": jnp.ones((128, 64))}, tx=optax.adamw(1e-3))
+        spec = str(state.grad_accum["w"].sharding.spec)
+        if stage == 1:
+            assert "fsdp" not in spec, f"stage 1 grads must stay replicated, got {spec}"
+        else:
+            assert "fsdp" in spec, f"stage 2 grads must shard over fsdp, got {spec}"
+        # opt state shards either way
+        mu_specs = [
+            str(x.sharding.spec)
+            for x in jax.tree_util.tree_leaves(state.opt_state)
+            if hasattr(x, "sharding") and x.shape == (128, 64)
+        ]
+        assert all("fsdp" in s for s in mu_specs)
+
+    def test_stage1_and_stage2_numerics_match(self):
+        from accelerate_tpu.models.transformer import Transformer, TransformerConfig, lm_loss_fn
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        cfg = TransformerConfig.tiny()
+        model = Transformer(cfg)
+        batch = {
+            "input_ids": np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        }
+        losses = []
+        for stage in (1, 2):
+            GradientState._reset_state()
+            AcceleratorState._reset_state(reset_partial_state=True)
+            acc = Accelerator(
+                deepspeed_plugin=ZeroPlugin(zero_stage=stage), gradient_accumulation_steps=2
+            )
+            params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 16), jnp.int32))["params"]
+            state = acc.create_train_state(params=params, tx=optax.adamw(1e-2), seed=0)
+            step = acc.compile_train_step(lm_loss_fn(model))
+            for _ in range(4):
+                state, metrics = step(state, batch)
+            losses.append(float(jax.device_get(metrics["loss"])))
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
 
 class TestHybridMesh:
     def test_hybrid_mesh_builds(self):
