@@ -33,16 +33,27 @@ from .big_modeling import (
     shard_params_for_inference,
 )
 from .launchers import debug_launcher, notebook_launcher
+from .ops import (
+    Int4Config,
+    Int8Config,
+    QuantizationConfig,
+    quantize_model_params,
+)
 from .local_sgd import LocalSGD
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .train_state import DynamicLossScale, TrainState
 from .utils import (
+    CollectiveKwargs,
+    CompilationConfig,
     DataLoaderConfiguration,
     DistributedType,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
+    GradScalerKwargs,
     GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
     MeshConfig,
     ModelParallelPlugin,
     PrecisionPolicy,

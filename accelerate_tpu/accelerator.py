@@ -134,9 +134,12 @@ class Accelerator:
             self.project_configuration.set_directories(project_dir)
 
         # kwargs handlers (reference accelerator.py:338-375)
+        from .utils.dataclasses import FP8RecipeKwargs
+
         self.scaler_handler: Optional[GradScalerKwargs] = None
         self.collective_handler: Optional[CollectiveKwargs] = None
         self.init_handler: Optional[InitProcessGroupKwargs] = None
+        self.fp8_recipe_handler: Optional[FP8RecipeKwargs] = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -144,6 +147,10 @@ class Accelerator:
                 self.collective_handler = handler
             elif isinstance(handler, InitProcessGroupKwargs):
                 self.init_handler = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe_handler = handler
+        if self.fp8_recipe_handler is None and mixed_precision == "fp8":
+            self.fp8_recipe_handler = FP8RecipeKwargs()
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
@@ -155,6 +162,8 @@ class Accelerator:
             deepspeed_plugin = ZeroPlugin()
         if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_USE_FSDP"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
+        if megatron_lm_plugin is None and parse_flag_from_env("ACCELERATE_USE_MEGATRON_LM"):
+            megatron_lm_plugin = ModelParallelPlugin()
 
         init_kwargs = self.init_handler.to_kwargs() if self.init_handler else {}
         init_kwargs.pop("backend", None)
@@ -181,6 +190,14 @@ class Accelerator:
             self.dataloader_config.split_batches = True
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
         self.compilation_config = compilation_config or CompilationConfig()
+        # FSDP activation_checkpointing / ModelParallel recompute_activations
+        # lower onto the one remat mechanism (jax.checkpoint over the loss).
+        wants_remat = (
+            (fsdp_plugin is not None and fsdp_plugin.activation_checkpointing)
+            or (megatron_lm_plugin is not None and megatron_lm_plugin.recompute_activations)
+        )
+        if wants_remat and self.compilation_config.remat_policy == "none":
+            self.compilation_config.remat_policy = "full"
         self.rng_types = rng_types or ["generator"]
 
         self.log_with = [log_with] if isinstance(log_with, str) else (log_with or [])
@@ -440,9 +457,51 @@ class Accelerator:
             self._schedulers.append(sched)
             return sched
         if _is_model_like(obj):
+            obj = self._maybe_apply_fp8(obj)
             self._models.append(obj)
             return obj
         return obj
+
+    def _maybe_apply_fp8(self, model):
+        """Under ``mixed_precision="fp8"`` rebuild the model with fp8 matmuls.
+
+        The TE analog (reference ``accelerator.py:1378-1392`` swaps Linear for
+        ``te.Linear``): here models that expose a config with ``use_fp8`` get it
+        flipped so their Dense layers use :func:`ops.fp8.fp8_dot_general`.
+        """
+        if self.mixed_precision != "fp8":
+            return model
+        cfg = getattr(model, "config", None)
+        import dataclasses as _dc
+
+        if cfg is not None and _dc.is_dataclass(cfg) and hasattr(cfg, "use_fp8"):
+            if getattr(cfg, "quantization", None) is not None:
+                import warnings
+
+                warnings.warn(
+                    "mixed_precision='fp8': model is int-quantized (weights already "
+                    "dequantize into the matmul); leaving it unchanged.",
+                    stacklevel=3,
+                )
+                return model
+            recipe = self.fp8_recipe_handler
+            replacements = {
+                "use_fp8": True,
+                "fp8_margin": int(getattr(recipe, "margin", 0) or 0),
+            }
+            if hasattr(cfg, "fp8_format"):
+                replacements["fp8_format"] = str(getattr(recipe, "fp8_format", "HYBRID"))
+            return type(model)(_dc.replace(cfg, **replacements))
+        import warnings
+
+        warnings.warn(
+            f"mixed_precision='fp8': {type(model).__name__} has no fp8-capable config "
+            "(a dataclass with a use_fp8 field); its matmuls stay in bf16. Inject "
+            "accelerate_tpu.ops.fp8.fp8_dot_general into the model's Dense layers "
+            "to opt in.",
+            stacklevel=3,
+        )
+        return model
 
     def prepare_data_loader(self, data_loader, device_placement: Optional[bool] = None):
         if isinstance(data_loader, (DataLoaderShard, DataLoaderDispatcher)):
@@ -484,6 +543,12 @@ class Accelerator:
             rng = jax.random.PRNGKey(seed)
         params = self.policy.cast_to_param(params)
 
+        grad_accum_dtype = None
+        if self.collective_handler and self.collective_handler.grad_reduce_dtype:
+            from .utils.dataclasses import TENSOR_DTYPES
+
+            grad_accum_dtype = TENSOR_DTYPES[self.collective_handler.grad_reduce_dtype]
+
         def init_fn(p):
             return TrainState.create(
                 apply_fn=apply_fn,
@@ -493,6 +558,7 @@ class Accelerator:
                 use_loss_scaling=self.policy.use_loss_scaling,
                 init_loss_scale=(self.scaler_handler.init_scale if self.scaler_handler else 2.0**16),
                 rng=rng,
+                grad_accum_dtype=grad_accum_dtype,
             )
 
         abstract = jax.eval_shape(init_fn, params)
@@ -623,6 +689,31 @@ class Accelerator:
             )
         return offload_params, offload_opt
 
+    def _maybe_remat(self, wrapped_loss: Callable) -> Callable:
+        """Apply ``CompilationConfig.remat_policy`` (activation checkpointing).
+
+        One mechanism serves FSDP ``activation_checkpointing``, ModelParallel
+        ``recompute_activations`` (both lower to remat_policy="full" at init)
+        and the explicit policy dial: the loss computation is wrapped in
+        ``jax.checkpoint`` so the backward pass recomputes instead of saving
+        intermediates XLA would otherwise keep in HBM.
+        """
+        name = self.compilation_config.remat_policy
+        if name in (None, "none"):
+            return wrapped_loss
+        policies = {
+            "full": None,  # save nothing, recompute everything
+            "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+            "dots_saveable": jax.checkpoint_policies.dots_saveable,
+            "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "everything_saveable": jax.checkpoint_policies.everything_saveable,
+        }
+        if name not in policies:
+            raise ValueError(
+                f"Unknown remat_policy {name!r}; expected one of {['none', *policies]}"
+            )
+        return jax.checkpoint(wrapped_loss, policy=policies[name], prevent_cse=False)
+
     def _wrap_loss_fn(self, loss_fn: Callable, has_aux: bool):
         """Normalize loss_fn(params, batch[, rng]) and apply the precision policy."""
         try:
@@ -676,18 +767,32 @@ class Accelerator:
         (``accelerator.py:912-1069``) without the Python-side no_sync dance.
         """
         wrapped_loss = self._wrap_loss_fn(loss_fn, has_aux)
+        wrapped_loss = self._maybe_remat(wrapped_loss)
         accum = self.gradient_accumulation_steps
         policy = self.policy
         fp16 = policy.use_loss_scaling
+        # Gradient carry dtype (the DDP fp16/bf16 compression-hook analog):
+        # grads are cast to this dtype right after the backward pass, halving
+        # the accumulation buffer and any cross-step traffic under bf16.  Note
+        # the in-step cross-replica reduction itself rides the *compute* dtype
+        # (XLA reduce-scatters the bf16 dot-transpose partials under a bf16
+        # policy before this cast); averaging/clipping/update stay fp32.
+        reduce_dtype = jnp.float32
         if self.collective_handler and self.collective_handler.grad_reduce_dtype:
-            import warnings
+            if accum > 1:
+                from .utils.dataclasses import TENSOR_DTYPES
 
-            warnings.warn(
-                "CollectiveKwargs.grad_reduce_dtype requires the explicit shard_map "
-                "gradient path (not yet wired); XLA currently reduces in the compute "
-                "dtype. The knob is accepted but has no effect.",
-                stacklevel=2,
-            )
+                reduce_dtype = TENSOR_DTYPES[self.collective_handler.grad_reduce_dtype]
+            else:
+                import warnings
+
+                warnings.warn(
+                    "CollectiveKwargs.grad_reduce_dtype sets the gradient "
+                    "accumulation-buffer dtype; with gradient_accumulation_steps=1 "
+                    "there is no buffer to cast (the in-step reduction already runs "
+                    "in the compute dtype), so it is ignored.",
+                    stacklevel=2,
+                )
 
         offload_params, offload_opt = self._offload_flags(warn=True)
         if offload_opt or offload_params:
@@ -715,7 +820,9 @@ class Accelerator:
                 return loss * scale, (loss, aux)
 
             grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(state.params)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / scale, grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) / scale).astype(reduce_dtype), grads
+            )
 
             count = state.micro_step + 1
             if accum > 1:
@@ -725,7 +832,9 @@ class Accelerator:
                 acc = grads
                 do_sync = jnp.asarray(True)
 
-            avg = jax.tree_util.tree_map(lambda g: g / count.astype(jnp.float32), acc)
+            avg = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / count.astype(jnp.float32), acc
+            )
             gnorm = global_norm(avg)
             if max_grad_norm is not None:
                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
@@ -1101,12 +1210,19 @@ class Accelerator:
         save_directory: str,
         max_shard_size: Union[int, str] = "10GB",
         safe_serialization: bool = True,
+        save_dtype=None,
     ):
         from .checkpointing import save_model
 
+        if (
+            save_dtype is None
+            and self.state.zero_plugin is not None
+            and self.state.zero_plugin.zero3_save_16bit_model
+        ):
+            save_dtype = jnp.bfloat16
         return save_model(
             self, state_or_params, save_directory, max_shard_size=max_shard_size,
-            safe_serialization=safe_serialization,
+            safe_serialization=safe_serialization, save_dtype=save_dtype,
         )
 
     def register_save_state_pre_hook(self, hook: Callable):

@@ -241,6 +241,7 @@ def load_checkpoint_and_dispatch(
     offload_folder: Optional[str] = None,
     dtype=None,
     mesh=None,
+    quantization=None,
 ):
     """Load a safetensors checkpoint with placement decided *before* any tensor
     is read (reference ``load_checkpoint_and_dispatch``, ``big_modeling.py:499-628``).
@@ -251,15 +252,32 @@ def load_checkpoint_and_dispatch(
         to cpu/disk;
       * explicit dict — your placement.
 
+    ``quantization`` (a :class:`~accelerate_tpu.ops.quantization.QuantizationConfig`,
+    e.g. ``Int8Config()``) quantizes eligible kernels as they are read — the
+    ``load_and_quantize_model`` analog (reference ``utils/bnb.py:44-467``):
+    placement budgets see the quantized (4x/8x smaller) sizes, and the returned
+    tree matches a model built with ``TransformerConfig(quantization=bits)``.
+
     Returns ``(params, device_map, weights_loader)``; disk-mapped tensors are
     NOT copied — the loader reads them zero-copy from the checkpoint itself.
     """
     files = _checkpoint_files(checkpoint)
     flat_shapes = checkpoint_shapes(checkpoint, files=files)
+    quantize_flat = None
+    if quantization is not None:
+        from .ops.quantization import quantize_flat_tree as quantize_flat
+
+        flat_shapes = quantize_flat(flat_shapes, quantization, sep=SEP)
     abstract = unflatten_tree(flat_shapes)
 
+    def read(keys):
+        flat = _read_tensors(files, keys, dtype)
+        if quantize_flat is not None:
+            flat = quantize_flat(flat, quantization, sep=SEP)
+        return flat
+
     if device_map == "sharded":
-        flat = _read_tensors(files, list(files.keys()), dtype)
+        flat = read(list(files.keys()))
         params = shard_params_for_inference(unflatten_tree(flat), mesh=mesh)
         return params, "sharded", None
 
@@ -278,18 +296,24 @@ def load_checkpoint_and_dispatch(
     safetensors_refs: Dict[str, str] = {}
     for mod in top_level_modules(abstract):
         target = device_map[mod]
-        keys = [k for k in flat_shapes if k == mod or k.startswith(mod + SEP)]
+        keys = [k for k in files if k == mod or k.startswith(mod + SEP)]
         if target == "disk":
+            if quantization is not None:
+                raise ValueError(
+                    "quantization with disk-mapped modules is not supported: disk "
+                    "entries are zero-copy references into the fp checkpoint. Raise "
+                    "max_memory (quantized weights are 4-8x smaller) or use 'cpu'."
+                )
             # zero-copy: leave bytes in the checkpoint, remember the file
             for k in keys:
                 safetensors_refs[k] = files[k]
             placed[mod] = None
         elif target == "cpu":
-            flat = _read_tensors(files, keys, dtype)
+            flat = read(keys)
             host_entries.update(flat)
             placed[mod] = _strip_prefix(flat, mod)
         else:
-            flat = _read_tensors(files, keys, dtype)
+            flat = read(keys)
             placed[mod] = jax.device_put(_strip_prefix(flat, mod), devices[int(target)])
     loader = None
     if host_entries or safetensors_refs:

@@ -301,11 +301,14 @@ def save_model(
     save_directory: str,
     max_shard_size="10GB",
     safe_serialization: bool = True,
+    save_dtype=None,
 ) -> List[str]:
     """Export model weights as (sharded) safetensors (reference ``accelerator.py:2712-2824``).
 
     Weights are gathered to host on the main process; the file layout matches the
     HF ecosystem (``model.safetensors`` or N shards + ``model.safetensors.index.json``).
+    ``save_dtype`` casts floating weights on export (``ZeroPlugin.
+    zero3_save_16bit_model`` passes bf16 — the fp32 masters stay untouched).
     """
     from safetensors.numpy import save_file
 
@@ -317,6 +320,11 @@ def save_model(
     if not accelerator.is_main_process:
         accelerator.wait_for_everyone()
         return []
+    if save_dtype is not None:
+        host = jax.tree_util.tree_map(
+            lambda x: x.astype(save_dtype) if np.issubdtype(x.dtype, np.floating) else x,
+            host,
+        )
     os.makedirs(save_directory, exist_ok=True)
     flat = _flatten_params(host)
     limit = parse_size(max_shard_size)

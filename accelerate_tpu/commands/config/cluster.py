@@ -81,7 +81,8 @@ def get_cluster_input() -> ClusterConfig:
         mp_config = {
             "tp_degree": _ask("Tensor-parallel degree", "1", int),
             "pp_degree": _ask("Pipeline-parallel degree", "1", int),
-            "sequence_parallelism": _ask_bool("Enable sequence parallelism", False),
+            "sp_degree": _ask("Sequence-parallel degree (ring attention)", "1", int),
+            "recompute_activations": _ask_bool("Recompute activations (remat)", False),
         }
 
     compute_env = ComputeEnvironment.TPU_POD.value if num_machines > 1 else ComputeEnvironment.LOCAL_MACHINE.value

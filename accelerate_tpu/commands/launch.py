@@ -76,7 +76,10 @@ def launch_command_parser(subparsers=None):
     mp.add_argument("--use_megatron_lm", "--use_model_parallel", dest="use_model_parallel", action="store_true")
     mp.add_argument("--tp_degree", type=int, default=None)
     mp.add_argument("--pp_degree", type=int, default=None)
-    mp.add_argument("--sequence_parallelism", action="store_true")
+    mp.add_argument("--sp_degree", type=int, default=None,
+                    help="Sequence/context-parallel degree (ring attention over the sp mesh axis).")
+    mp.add_argument("--recompute_activations", action="store_true",
+                    help="Activation checkpointing for the model-parallel stack (remat).")
 
     parser.add_argument("-m", "--module", action="store_true", help="Treat the script as a python module.")
     parser.add_argument("training_script", help="Script (or module with -m) to launch.")
@@ -131,14 +134,16 @@ def _merge_with_config(args) -> ClusterConfig:
             zc["offload_param_device"] = args.offload_param_device
         zc.setdefault("zero_stage", 2)
         config.zero_config = zc
-    if args.use_model_parallel or args.tp_degree or args.pp_degree:
+    if args.use_model_parallel or args.tp_degree or args.pp_degree or args.sp_degree:
         mc = dict(config.model_parallel_config)
         if args.tp_degree is not None:
             mc["tp_degree"] = args.tp_degree
         if args.pp_degree is not None:
             mc["pp_degree"] = args.pp_degree
-        if args.sequence_parallelism:
-            mc["sequence_parallelism"] = True
+        if args.sp_degree is not None:
+            mc["sp_degree"] = args.sp_degree
+        if args.recompute_activations:
+            mc["recompute_activations"] = True
         config.model_parallel_config = mc
     return config
 
@@ -196,8 +201,10 @@ def prepare_launch_env(config: ClusterConfig) -> Dict[str, str]:
             env["MEGATRON_LM_TP_DEGREE"] = str(mc["tp_degree"])
         if mc.get("pp_degree") is not None:
             env["MEGATRON_LM_PP_DEGREE"] = str(mc["pp_degree"])
-        if mc.get("sequence_parallelism"):
-            env["MEGATRON_LM_SEQUENCE_PARALLELISM"] = "true"
+        if mc.get("sp_degree") is not None:
+            env["MEGATRON_LM_SP_DEGREE"] = str(mc["sp_degree"])
+        if mc.get("recompute_activations"):
+            env["MEGATRON_LM_RECOMPUTE_ACTIVATIONS"] = "true"
     return env
 
 

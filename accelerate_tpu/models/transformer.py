@@ -46,6 +46,18 @@ class TransformerConfig:
     scan_layers: bool = False          # roll layers into lax.scan
     attention_impl: str = "xla"        # "xla" | "pallas" | "ring"
     dropout_rate: float = 0.0
+    # fp8 matmuls (TransformerEngine analog, ops/fp8.py): projection/MLP dots
+    # quantize operands to e4m3 fwd / e5m2 bwd with just-in-time scaling.
+    # Set via Accelerator(mixed_precision="fp8") + prepare(model), or directly.
+    use_fp8: bool = False
+    fp8_margin: int = 0
+    fp8_format: str = "HYBRID"         # "HYBRID" (e4m3 fwd / e5m2 bwd) | "E4M3"
+    # Weight-only int8/int4 inference (bnb analog, ops/quantization.py):
+    # projection/MLP kernels become qweight+scales params dequantized in-kernel.
+    # Convert trained weights with quantize_model_params, or pass
+    # quantization=... to load_checkpoint_and_dispatch.
+    quantization: Optional[int] = None  # None | 8 | 4
+    quantization_block_size: int = 64
     # Mixture-of-Experts (num_experts == 0 -> dense MLP).  Reference MoE surface
     # is DeepSpeed passthrough only (utils/dataclasses.py:792-798); here experts
     # are a first-class stacked axis sharded over the ``ep`` mesh axis.
@@ -141,6 +153,35 @@ class Attention(nn.Module):
 
 
 def functools_partial_dense(cfg: TransformerConfig):
+    if cfg.quantization is not None:
+        if cfg.use_fp8:
+            raise ValueError(
+                "quantization and use_fp8 are mutually exclusive: int8/int4 weights "
+                "already dequantize straight into the matmul. Drop mixed_precision='fp8' "
+                "for quantized-inference models."
+            )
+        from ..ops.quantization import QuantizedDense
+
+        def make_q(name: str, features: int):
+            return QuantizedDense(
+                features,
+                bits=cfg.quantization,
+                block_size=cfg.quantization_block_size,
+                dtype=cfg.dtype,
+                name=name,
+            )
+
+        return make_q
+
+    extra = {}
+    if cfg.use_fp8:
+        from ..ops.fp8 import make_fp8_dot_general
+        from ..utils.dataclasses import FP8RecipeKwargs
+
+        extra["dot_general"] = make_fp8_dot_general(
+            FP8RecipeKwargs(margin=cfg.fp8_margin, fp8_format=cfg.fp8_format)
+        )
+
     def make(name: str, features: int):
         return nn.Dense(
             features,
@@ -149,6 +190,7 @@ def functools_partial_dense(cfg: TransformerConfig):
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02),
             name=name,
+            **extra,
         )
 
     return make

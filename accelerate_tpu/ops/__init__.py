@@ -1,1 +1,24 @@
-"""ops subpackage."""
+"""ops subpackage: attention dispatch, pallas flash attention, fp8 matmuls,
+weight-only quantization."""
+
+from .fp8 import (
+    DelayedScalingState,
+    fp8_dot_general,
+    fp8_dot_general_delayed,
+    make_fp8_dot_general,
+)
+from .quantization import (
+    Int4Config,
+    Int8Config,
+    QuantizationConfig,
+    QuantizedDense,
+    QuantizedTensor,
+    dequantize,
+    dequantize_params,
+    is_quantized,
+    quantize,
+    quantize_model_params,
+    quantize_params,
+    quantized_matmul,
+    quantized_nbytes,
+)

@@ -1,0 +1,322 @@
+"""Weight-only int8/int4 quantization — the bitsandbytes analog.
+
+Reference: ``load_and_quantize_model`` (``src/accelerate/utils/bnb.py:44-467``)
+swaps ``nn.Linear`` for bnb CUDA kernels (8-bit vector-wise / 4-bit NF4).  The
+TPU-native shape is weight-only quantization with dequant-in-kernel: weights
+live in HBM (or stream from host) as int8/packed-int4 plus scales — 4x/8x
+smaller than fp32 — and are dequantized to the compute dtype inside the jitted
+matmul, where XLA fuses the int→float convert + scale multiply into the GEMM
+prologue.  Activations stay bf16 (W8A16 / W4A16), which preserves accuracy and
+keeps the MXU fed; the win is HBM capacity + bandwidth, exactly the resource
+big-model inference is short on.
+
+Formats:
+
+* **int8** — symmetric per-output-channel scales: ``w ≈ q * scale[col]``,
+  ``q ∈ [-127, 127]``.
+* **int4** — symmetric per-block scales along the contraction dim (default
+  block 64), two nibbles packed per byte: ``[K, N] -> data [K//2, N] uint8 +
+  scales [K//block, N]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizationConfig:
+    """What to quantize and how (reference ``BnbQuantizationConfig``,
+    ``utils/bnb.py``/``utils/dataclasses.py``).
+
+    ``min_size`` skips small tensors (biases, norms) where scales would cost
+    more than they save; ``skip_patterns`` skips modules by substring — the
+    reference's ``skip_modules`` (lm_head stays fp by default there too).
+    """
+
+    bits: int = 8                      # 8 | 4
+    block_size: int = 64               # int4 contraction-dim block
+    min_size: int = 4096               # leaves smaller than this stay fp
+    min_ndim: int = 2                  # only matmul weights quantize
+    skip_patterns: Tuple[str, ...] = ("lm_head", "embed")
+    keep_dtype: Any = jnp.bfloat16     # dequant target dtype
+
+    def __post_init__(self):
+        if self.bits not in (8, 4):
+            raise ValueError(f"Only 8- and 4-bit quantization are supported, got {self.bits}")
+        if self.bits == 4 and self.block_size % 2 != 0:
+            raise ValueError("int4 block_size must be even")
+
+
+def Int8Config(**kw) -> QuantizationConfig:
+    return QuantizationConfig(bits=8, **kw)
+
+
+def Int4Config(**kw) -> QuantizationConfig:
+    return QuantizationConfig(bits=4, **kw)
+
+
+class QuantizedTensor(struct.PyTreeNode):
+    """A quantized weight: int data + scales + static layout metadata.
+
+    Registered as a pytree so it flows through ``jax.device_put`` / shardings /
+    ``tree_map`` like any array leaf (use ``is_quantized`` to detect it).
+    """
+
+    data: jax.Array                    # int8 [K, N] or packed uint8 [K//2, N]
+    scales: jax.Array                  # [N] (int8) or [K//block, N] (int4)
+    shape: Tuple[int, ...] = struct.field(pytree_node=False)
+    bits: int = struct.field(pytree_node=False, default=8)
+    block_size: int = struct.field(pytree_node=False, default=64)
+
+    @property
+    def dtype(self):
+        return self.scales.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod([int(s) for s in self.data.shape])) * self.data.dtype.itemsize + int(
+            np.prod([int(s) for s in self.scales.shape])
+        ) * self.scales.dtype.itemsize
+
+
+def is_quantized(x) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+# ------------------------------------------------------------------ int8
+def _quantize_int8(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel (last dim) int8."""
+    w = jnp.asarray(w)
+    mat = w.reshape(-1, w.shape[-1]).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(mat), axis=0)
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(mat / scales), -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+# ------------------------------------------------------------------ int4
+def _pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int4 values (in int8 storage, range [-8, 7]) pairwise along axis 0:
+    ``[K, N] int8 -> [K//2, N] uint8`` (low nibble = even rows)."""
+    u = (q.astype(jnp.int32) & 0xF).astype(jnp.uint8)
+    return (u[0::2] | (u[1::2] << 4)).astype(jnp.uint8)
+
+
+def _unpack_int4(packed: jax.Array, k: int) -> jax.Array:
+    """Inverse of :func:`_pack_int4` -> int8 values in [-8, 7], shape [K, N]."""
+    low = (packed & 0xF).astype(jnp.int8)
+    high = ((packed >> 4) & 0xF).astype(jnp.int8)
+    low = jnp.where(low >= 8, low - 16, low)
+    high = jnp.where(high >= 8, high - 16, high)
+    out = jnp.stack([low, high], axis=1).reshape(-1, packed.shape[-1])
+    return out[:k]
+
+
+def _quantize_int4(w: jax.Array, block_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-(contraction-block, column) int4 with nibble packing."""
+    w = jnp.asarray(w)
+    mat = w.reshape(-1, w.shape[-1]).astype(jnp.float32)
+    k, n = mat.shape
+    pad = (-k) % block_size
+    if pad:
+        mat = jnp.concatenate([mat, jnp.zeros((pad, n), jnp.float32)], axis=0)
+    blocks = mat.reshape(-1, block_size, n)
+    amax = jnp.max(jnp.abs(blocks), axis=1)                      # [K/bs, N]
+    scales = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(blocks / scales[:, None, :]), -8, 7)
+    q = q.reshape(-1, n).astype(jnp.int8)
+    return _pack_int4(q), scales
+
+
+def quantize(w, config: QuantizationConfig) -> QuantizedTensor:
+    """Quantize one weight tensor per ``config``."""
+    w = jnp.asarray(w)
+    if config.bits == 8:
+        data, scales = _quantize_int8(w)
+    else:
+        data, scales = _quantize_int4(w, config.block_size)
+    return QuantizedTensor(
+        data=data,
+        scales=scales.astype(jnp.float32),
+        shape=tuple(int(s) for s in w.shape),
+        bits=config.bits,
+        block_size=config.block_size,
+    )
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    """Materialize the fp weight (jit-fusable; called inside the matmul)."""
+    k = int(np.prod(qt.shape[:-1]))
+    if qt.bits == 8:
+        mat = qt.data.astype(jnp.float32) * qt.scales
+    else:
+        vals = _unpack_int4(qt.data, ((k + qt.block_size - 1) // qt.block_size) * qt.block_size)
+        blocks = vals.reshape(-1, qt.block_size, qt.shape[-1]).astype(jnp.float32)
+        mat = (blocks * qt.scales[:, None, :]).reshape(-1, qt.shape[-1])[:k]
+    return mat.reshape(qt.shape).astype(dtype)
+
+
+def quantized_matmul(x: jax.Array, qt: QuantizedTensor, dtype=None) -> jax.Array:
+    """``x @ w`` with in-kernel dequantization (W8A16/W4A16)."""
+    dtype = dtype or x.dtype
+    return x @ dequantize(qt, dtype)
+
+
+# ------------------------------------------------------------ tree surgery
+def _should_quantize(path: str, leaf, config: QuantizationConfig) -> bool:
+    shape = getattr(leaf, "shape", ())
+    if len(shape) < config.min_ndim:
+        return False
+    if int(np.prod([int(s) for s in shape])) < config.min_size:
+        return False
+    if not jnp.issubdtype(getattr(leaf, "dtype", jnp.int32), jnp.floating):
+        return False
+    lowered = path.lower()
+    return not any(pat in lowered for pat in config.skip_patterns)
+
+
+def quantize_params(params, config: QuantizationConfig):
+    """Quantize every eligible weight in a pytree (bnb
+    ``replace_with_bnb_layers`` analog, ``utils/bnb.py:179``).
+
+    Eligible = floating, ``ndim >= min_ndim``, ``size >= min_size``, path not
+    matching ``skip_patterns``.  Other leaves pass through unchanged.
+    """
+    from ..utils.modeling import SEP
+
+    def visit(path, leaf):
+        path_str = SEP.join(
+            str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))) for p in path
+        )
+        if _should_quantize(path_str, leaf, config):
+            return quantize(leaf, config)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def dequantize_params(params, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_params` (materializes fp copies)."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x, dtype) if is_quantized(x) else x,
+        params,
+        is_leaf=lambda x: is_quantized(x),
+    )
+
+
+class QuantizedDense(nn.Module):
+    """flax Dense with int8/int4 weights dequantized in-kernel (bnb
+    ``Linear8bitLt``/``Linear4bit`` analog, reference ``utils/bnb.py:179``).
+
+    Parameters are ``qweight`` (int8 / packed uint8) + ``scales`` instead of
+    ``kernel``; convert a trained fp tree with :func:`quantize_model_params`.
+    """
+
+    features: int
+    bits: int = 8
+    block_size: int = 64
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        k = x.shape[-1]
+        if self.bits == 8:
+            data = self.param("qweight", nn.initializers.zeros, (k, self.features), jnp.int8)
+            scales = self.param("scales", nn.initializers.ones, (self.features,), jnp.float32)
+        else:
+            k_pad = ((k + self.block_size - 1) // self.block_size) * self.block_size
+            data = self.param(
+                "qweight", nn.initializers.zeros, (k_pad // 2, self.features), jnp.uint8
+            )
+            scales = self.param(
+                "scales", nn.initializers.ones, (k_pad // self.block_size, self.features),
+                jnp.float32,
+            )
+        qt = QuantizedTensor(
+            data=data, scales=scales, shape=(k, self.features),
+            bits=self.bits, block_size=self.block_size,
+        )
+        y = quantized_matmul(x.astype(self.dtype), qt, self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def quantize_model_params(params, config: QuantizationConfig):
+    """Convert a trained fp param tree into the layout :class:`QuantizedDense`
+    expects: every 2-D ``kernel`` leaf (outside ``skip_patterns``) becomes
+    sibling ``qweight`` + ``scales`` leaves.
+
+    Unlike :func:`quantize_params` this mirrors the *module structure* exactly
+    (no size gate), so the converted tree loads into a model built with
+    ``TransformerConfig(quantization=8|4)``.
+    """
+    from ..utils.modeling import SEP, flatten_tree, unflatten_tree
+
+    flat = flatten_tree(params)
+    return unflatten_tree(quantize_flat_tree(flat, config, sep=SEP))
+
+
+def quantize_flat_tree(flat: Dict[str, Any], config: QuantizationConfig, sep: str = ".") -> Dict[str, Any]:
+    """Flat-dict version of :func:`quantize_model_params` (used by
+    ``load_checkpoint_and_dispatch`` so placement sees quantized sizes)."""
+    out: Dict[str, Any] = {}
+    for key, leaf in flat.items():
+        if _kernel_eligible(key, leaf, config, sep):
+            base = key[: -len(sep + "kernel")]
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                q_shapes = _quantized_abstract(leaf.shape, config)
+                out[base + sep + "qweight"] = q_shapes[0]
+                out[base + sep + "scales"] = q_shapes[1]
+            else:
+                qt = quantize(leaf, config)
+                out[base + sep + "qweight"] = qt.data
+                out[base + sep + "scales"] = qt.scales
+        else:
+            out[key] = leaf
+    return out
+
+
+def _kernel_eligible(key: str, leaf, config: QuantizationConfig, sep: str) -> bool:
+    if not key.endswith(sep + "kernel"):
+        return False
+    if len(getattr(leaf, "shape", ())) != 2:
+        return False
+    lowered = key.lower()
+    return not any(pat in lowered for pat in config.skip_patterns)
+
+
+def _quantized_abstract(shape, config: QuantizationConfig):
+    """ShapeDtypeStructs for (qweight, scales) of a ``[K, N]`` kernel."""
+    k, n = int(shape[0]), int(shape[1])
+    if config.bits == 8:
+        return (
+            jax.ShapeDtypeStruct((k, n), jnp.int8),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        )
+    k_pad = ((k + config.block_size - 1) // config.block_size) * config.block_size
+    return (
+        jax.ShapeDtypeStruct((k_pad // 2, n), jnp.uint8),
+        jax.ShapeDtypeStruct((k_pad // config.block_size, n), jnp.float32),
+    )
+
+
+def quantized_nbytes(params) -> int:
+    """Total parameter bytes with quantization applied (estimate-memory hook)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=is_quantized):
+        if is_quantized(leaf):
+            total += leaf.nbytes
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total

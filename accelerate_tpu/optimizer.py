@@ -78,7 +78,10 @@ class AcceleratedOptimizer:
         if self._accelerator is not None:
             by_tx = getattr(self._accelerator, "_latest_state_by_tx", {})
             state = by_tx.get(id(self.optimizer))
-            if state is None and len(by_tx) <= 1:
+            if state is None and len(getattr(self._accelerator, "_optimizers", [])) <= 1:
+                # single-optimizer convenience only: with several prepared
+                # optimizers an unmatched key must error, not grab a sibling's
+                # state
                 state = getattr(self._accelerator, "_latest_state", None)
         else:
             state = None

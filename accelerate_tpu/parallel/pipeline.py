@@ -112,7 +112,7 @@ def prepare_pipeline(
     model,
     params: dict,
     mesh: Optional[Mesh] = None,
-    num_microbatches: int = 8,
+    num_microbatches: Optional[int] = None,
     axis: str = "pp",
     jit: bool = True,
 ):
@@ -131,6 +131,17 @@ def prepare_pipeline(
         from ..state import PartialState
 
         mesh = PartialState().mesh
+    if num_microbatches is None:
+        # default from the active ModelParallelPlugin (reference MegatronLMPlugin
+        # num_micro_batches / pippy num_chunks), else the classic GPipe 8
+        from ..state import AcceleratorState
+
+        plugin = (
+            AcceleratorState().model_parallel_plugin
+            if AcceleratorState._shared_state
+            else None
+        )
+        num_microbatches = plugin.num_micro_batches if plugin is not None else 8
 
     def stage_fn(local_layers, x, positions):
         def body(h, layer_params):

@@ -89,10 +89,15 @@ class TrainState(struct.PyTreeNode):
         use_loss_scaling: bool = False,
         init_loss_scale: float = 2.0**16,
         rng: Optional[jax.Array] = None,
+        grad_accum_dtype: Optional[Any] = None,
     ) -> "TrainState":
         opt_state = tx.init(params)
         grad_accum = (
-            jax.tree_util.tree_map(jnp.zeros_like, params) if gradient_accumulation_steps > 1 else None
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, grad_accum_dtype or p.dtype), params
+            )
+            if gradient_accumulation_steps > 1
+            else None
         )
         return cls(
             step=jnp.zeros((), dtype=jnp.int32),

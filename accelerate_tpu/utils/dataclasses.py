@@ -174,12 +174,15 @@ class KwargsHandler:
 class CollectiveKwargs(KwargsHandler):
     """Analog of ``DistributedDataParallelKwargs`` (``utils/dataclasses.py:126``).
 
-    On TPU there is no DDP reducer; the tunables that survive are the gradient
-    cross-replica reduction dtype (the comm-hook fp16/bf16 compression analog:
-    cast grads before the XLA psum) and whether to reduce in float32.
+    On TPU there is no DDP reducer; the surviving tunable is the gradient
+    *carry* dtype (the comm-hook fp16/bf16 compression analog): grads are cast
+    to it right after backward, so the accumulation buffer and cross-step
+    traffic halve under bf16.  The in-step cross-replica reduction itself runs
+    in the compute dtype (XLA reduces the bf16 dot-transpose partials under a
+    bf16 policy).  Only meaningful with gradient_accumulation_steps > 1.
     """
 
-    grad_reduce_dtype: Optional[str] = None  # "bf16" | "fp16" | "fp32" | None (= compute dtype)
+    grad_reduce_dtype: Optional[str] = None  # "bf16" | "fp16" | "fp32" | None (= fp32 carry)
     bucket_cap_mb: int = 25                  # accepted for API parity; XLA handles bucketing
 
 
@@ -207,8 +210,12 @@ class InitProcessGroupKwargs(KwargsHandler):
 class FP8RecipeKwargs(KwargsHandler):
     """fp8 training knobs (reference ``FP8RecipeKwargs`` ``utils/dataclasses.py:271``).
 
-    TPU path: ``float8_e4m3fn``/``float8_e5m2`` matmul operands through XLA, with
-    delayed scaling ~ amax history, instead of TransformerEngine/MS-AMP CUDA.
+    TPU path (``ops/fp8.py``): ``float8_e4m3fn``/``float8_e5m2`` matmul operands
+    through XLA instead of TransformerEngine/MS-AMP CUDA.  ``margin`` and
+    ``fp8_format`` drive the stateless just-in-time-scaling path the model
+    integration uses; ``interval``/``amax_history_len``/``amax_compute_algo``
+    drive the explicit-state delayed-scaling API
+    (``DelayedScalingState`` / ``fp8_dot_general_delayed``).
     """
 
     margin: int = 0
@@ -268,7 +275,9 @@ class CompilationConfig(KwargsHandler):
 
     Everything is jit-compiled already; these control *how*:
       - ``remat_policy``: rematerialization, the memory/FLOPs dial
-        ("none" | "full" | "dots_saveable" | "nothing_saveable" | "save_dot_except_logits")
+        ("none" | "full" | "dots_saveable" | "nothing_saveable" |
+        "dots_with_no_batch_dims_saveable" | "everything_saveable"),
+        applied as ``jax.checkpoint`` over the loss in ``compile_train_step``
       - ``donate_state``: donate the train-state buffers to the step (in-place update)
       - ``scan_layers``: roll transformer layers into ``lax.scan`` (compile-time win)
     """
@@ -377,12 +386,17 @@ class ZeroPlugin:
     zero_stage: int = 2
     gradient_accumulation_steps: Optional[int] = None
     gradient_clipping: Optional[float] = None
-    offload_optimizer_device: str = "none"   # "none" | "cpu" | "nvme"
+    offload_optimizer_device: str = "none"   # "none" | "cpu"
     offload_param_device: str = "none"
-    nvme_path: Optional[str] = None
-    zero3_init_flag: bool = False            # init params shape-only (jax.eval_shape)
+    # Save fp32 master weights as bf16 in save_model (the reference's
+    # zero3_save_16bit_model, DeepSpeedPlugin stage3_gather_16bit_weights).
     zero3_save_16bit_model: bool = False
     train_micro_batch_size_per_gpu: Optional[int] = None
+    # Note: the reference's zero3_init_flag (meta-device init) has no knob here
+    # because create_train_state always initializes abstractly (jax.eval_shape +
+    # out_shardings) — full state is never materialized on one device.  NVMe
+    # offload is likewise not a separate device: disk-backed streaming lives in
+    # big_modeling/utils.offload.
 
     def __post_init__(self):
         if os.environ.get("ACCELERATE_DEEPSPEED_ZERO_STAGE"):
@@ -393,6 +407,14 @@ class ZeroPlugin:
             self.offload_param_device = os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"]
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"ZeRO stage must be 0-3, got {self.zero_stage}")
+        for field_name in ("offload_optimizer_device", "offload_param_device"):
+            device = getattr(self, field_name)
+            if device not in ("none", "cpu"):
+                raise ValueError(
+                    f"{field_name}={device!r} is not supported on the TPU runtime; "
+                    "use 'cpu' (pinned-host offload) or 'none'. Disk-backed weight "
+                    "streaming is available via big_modeling.load_checkpoint_and_dispatch."
+                )
 
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
         """Lower the ZeRO description onto the single sharding mechanism.
@@ -410,8 +432,8 @@ class ZeroPlugin:
         return FullyShardedDataParallelPlugin(
             sharding_strategy=strategy,
             min_weight_size=0 if self.zero_stage == 3 else 2**12,
-            cpu_offload=self.offload_param_device in ("cpu", "nvme"),
-            offload_optimizer=self.offload_optimizer_device in ("cpu", "nvme"),
+            cpu_offload=self.offload_param_device == "cpu",
+            offload_optimizer=self.offload_optimizer_device == "cpu",
             shard_gradients=self.zero_stage >= 2,
         )
 
@@ -430,17 +452,22 @@ class ModelParallelPlugin:
     pp_degree: int = 1
     sp_degree: int = 1           # sequence/context parallel degree (ring attention)
     expert_parallel_degree: int = 1
-    num_micro_batches: int = 1   # pipeline microbatches
-    sequence_parallelism: bool = False  # Megatron-style: shard LN/dropout activations within tp
-    recompute_activations: bool = False
+    num_micro_batches: int = 8   # pipeline microbatches (prepare_pipeline default)
+    recompute_activations: bool = False  # lowers to remat_policy="full" in Accelerator
+    # Note: the reference's within-tp `sequence_parallelism` flag (Megatron
+    # shards LN/dropout activations across tp ranks) is subsumed here by the
+    # first-class `sp_degree` axis — ring attention shards the whole sequence
+    # dimension, strictly more general (SURVEY §5.7).
 
     def __post_init__(self):
         if os.environ.get("MEGATRON_LM_TP_DEGREE"):
             self.tp_degree = int(os.environ["MEGATRON_LM_TP_DEGREE"])
         if os.environ.get("MEGATRON_LM_PP_DEGREE"):
             self.pp_degree = int(os.environ["MEGATRON_LM_PP_DEGREE"])
-        if os.environ.get("MEGATRON_LM_SEQUENCE_PARALLELISM"):
-            self.sequence_parallelism = parse_flag_from_env("MEGATRON_LM_SEQUENCE_PARALLELISM")
+        if os.environ.get("MEGATRON_LM_SP_DEGREE"):
+            self.sp_degree = int(os.environ["MEGATRON_LM_SP_DEGREE"])
+        if os.environ.get("MEGATRON_LM_RECOMPUTE_ACTIVATIONS"):
+            self.recompute_activations = parse_flag_from_env("MEGATRON_LM_RECOMPUTE_ACTIVATIONS")
 
     @property
     def model_parallel_size(self) -> int:

@@ -315,6 +315,15 @@ class TestOptimizerStateDict:
         assert opt_b.state_dict()["step"] == 1
         assert opt_a.state_dict()["step"] == 0
 
+    def test_unmatched_optimizer_raises_with_multiple_prepared(self):
+        acc = Accelerator()
+        opt_a = acc.prepare(optax.adamw(1e-2))
+        opt_b = acc.prepare(optax.adamw(1e-3))
+        acc.create_train_state(params={"w": jnp.ones((4, 4))}, tx=opt_a)
+        # only A has a state; B must error, not silently return A's
+        with pytest.raises(RuntimeError, match="No TrainState"):
+            opt_b.state_dict()
+
     def test_load_state_dict_updates_accelerator(self):
         acc = Accelerator()
         opt = acc.prepare(optax.adamw(1e-2))

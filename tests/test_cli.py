@@ -92,11 +92,16 @@ class TestLaunchEnvBuilders:
         assert env["ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"] == "cpu"
 
     def test_model_parallel_env(self):
-        cfg = ClusterConfig(model_parallel_config={"tp_degree": 4, "pp_degree": 2, "sequence_parallelism": True})
+        cfg = ClusterConfig(
+            model_parallel_config={
+                "tp_degree": 4, "pp_degree": 2, "sp_degree": 2, "recompute_activations": True,
+            }
+        )
         env = prepare_launch_env(cfg)
         assert env["MEGATRON_LM_TP_DEGREE"] == "4"
         assert env["MEGATRON_LM_PP_DEGREE"] == "2"
-        assert env["MEGATRON_LM_SEQUENCE_PARALLELISM"] == "true"
+        assert env["MEGATRON_LM_SP_DEGREE"] == "2"
+        assert env["MEGATRON_LM_RECOMPUTE_ACTIVATIONS"] == "true"
 
     def test_mesh_env(self):
         cfg = ClusterConfig(mesh={"fsdp": 4, "tp": 2}, dcn_mesh={"dp": 2})

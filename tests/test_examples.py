@@ -88,3 +88,8 @@ def test_feature_local_sgd():
 def test_feature_early_stopping():
     out = run_example("by_feature/early_stopping.py", "--num_epochs", "8")
     assert "early stop" in out or "without triggering" in out
+
+
+def test_feature_fp8():
+    out = run_example("by_feature/fp8.py", "--steps", "15")
+    assert "fp8 training" in out

@@ -1,0 +1,201 @@
+"""Weight-only int8/int4 quantization (bnb analog; reference
+tests/test_quantization.py exercises load_and_quantize_model, utils/bnb.py:44-467)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import Int4Config, Int8Config, load_checkpoint_and_dispatch, quantize_model_params
+from accelerate_tpu.models.transformer import Transformer, TransformerConfig
+from accelerate_tpu.ops.quantization import (
+    QuantizationConfig,
+    QuantizedDense,
+    _pack_int4,
+    _unpack_int4,
+    dequantize,
+    dequantize_params,
+    is_quantized,
+    quantize,
+    quantize_params,
+    quantized_matmul,
+    quantized_nbytes,
+)
+
+
+class TestQuantizeDequantize:
+    def test_int8_roundtrip_error(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+        qt = quantize(w, Int8Config())
+        deq = dequantize(qt, jnp.float32)
+        # symmetric per-channel int8: max error = scale/2 = amax/254 per column
+        err = np.abs(np.asarray(deq) - np.asarray(w))
+        col_amax = np.abs(np.asarray(w)).max(axis=0)
+        assert (err <= col_amax / 254 + 1e-6).all()
+
+    def test_int4_roundtrip_error(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (128, 16))
+        qt = quantize(w, Int4Config(block_size=32))
+        deq = dequantize(qt, jnp.float32)
+        err = np.abs(np.asarray(deq) - np.asarray(w))
+        # per-block scale/2 = block_amax/14
+        blocks = np.asarray(w).reshape(-1, 32, 16)
+        bound = np.repeat(np.abs(blocks).max(axis=1), 32, axis=0) / 14 + 1e-6
+        assert (err <= bound).all()
+
+    def test_int4_pack_unpack_exact(self):
+        q = jnp.asarray(np.random.default_rng(0).integers(-8, 8, (64, 8)), jnp.int8)
+        packed = _pack_int4(q)
+        assert packed.shape == (32, 8) and packed.dtype == jnp.uint8
+        np.testing.assert_array_equal(np.asarray(_unpack_int4(packed, 64)), np.asarray(q))
+
+    def test_int4_non_block_multiple_k(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (100, 8))
+        qt = quantize(w, Int4Config(block_size=64))
+        assert dequantize(qt).shape == (100, 8)
+
+    def test_memory_reduction(self):
+        w = jnp.ones((256, 256), jnp.float32)
+        q8 = quantize(w, Int8Config())
+        q4 = quantize(w, Int4Config())
+        fp_bytes = 256 * 256 * 4
+        assert q8.nbytes < fp_bytes / 3.5   # int8 + per-col scales
+        assert q4.nbytes < fp_bytes / 7     # packed int4 + block scales
+
+    def test_matmul_close(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 64))
+        w = jax.random.normal(jax.random.PRNGKey(2), (64, 32)) * 0.1
+        exact = x @ w
+        for cfg in (Int8Config(), Int4Config(block_size=32)):
+            approx = quantized_matmul(x, quantize(w, cfg), jnp.float32)
+            err = jnp.abs(approx - exact) / (jnp.abs(exact) + 1e-3)
+            tol = 0.02 if cfg.bits == 8 else 0.2
+            assert float(jnp.median(err)) < tol
+
+    def test_invalid_bits(self):
+        with pytest.raises(ValueError, match="8- and 4-bit"):
+            QuantizationConfig(bits=2)
+
+
+class TestTreeQuantization:
+    def test_quantize_params_gates(self):
+        params = {
+            "big": {"kernel": jnp.ones((128, 64))},
+            "tiny": {"kernel": jnp.ones((4, 4))},
+            "norm": {"scale": jnp.ones((64,))},
+            "lm_head": {"kernel": jnp.ones((64, 256))},
+        }
+        q = quantize_params(params, Int8Config())
+        assert is_quantized(q["big"]["kernel"])
+        assert not is_quantized(q["tiny"]["kernel"])       # below min_size
+        assert not is_quantized(q["norm"]["scale"])        # 1-D
+        assert not is_quantized(q["lm_head"]["kernel"])    # skip pattern
+        deq = dequantize_params(q, jnp.float32)
+        np.testing.assert_allclose(np.asarray(deq["big"]["kernel"]), 1.0, rtol=0.01)
+
+    def test_quantized_nbytes(self):
+        params = {"w": jnp.ones((256, 256))}
+        q = quantize_params(params, Int8Config(min_size=0))
+        assert quantized_nbytes(q) < quantized_nbytes(params) / 3.5
+
+
+class TestQuantizedModel:
+    def _fp_and_quantized(self, bits):
+        cfg = TransformerConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        model = Transformer(cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        qcfg = QuantizationConfig(bits=bits, block_size=32)
+        qparams = quantize_model_params(params, qcfg)
+        import dataclasses
+
+        qmodel = Transformer(dataclasses.replace(cfg, quantization=bits, quantization_block_size=32))
+        return model, params, qmodel, qparams, ids
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_structure_matches_model_init(self, bits):
+        model, params, qmodel, qparams, ids = self._fp_and_quantized(bits)
+        expected = jax.eval_shape(
+            lambda: qmodel.init(jax.random.PRNGKey(0), ids)
+        )["params"]
+        exp_flat = jax.tree_util.tree_leaves_with_path(expected)
+        q_flat = {jax.tree_util.keystr(p) for p, _ in jax.tree_util.tree_leaves_with_path(qparams)}
+        e_flat = {jax.tree_util.keystr(p) for p, _ in exp_flat}
+        assert q_flat == e_flat
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_quantized_forward_close(self, bits):
+        model, params, qmodel, qparams, ids = self._fp_and_quantized(bits)
+        ref = model.apply({"params": params}, ids)
+        got = qmodel.apply({"params": qparams}, ids)
+        # compare softmax distributions (logit scale is arbitrary)
+        p_ref = jax.nn.softmax(ref, axis=-1)
+        p_got = jax.nn.softmax(got, axis=-1)
+        tvd = 0.5 * float(jnp.abs(p_ref - p_got).sum(-1).mean())
+        assert tvd < (0.05 if bits == 8 else 0.25), tvd
+
+    def test_quantized_param_bytes_shrink(self):
+        model, params, qmodel, qparams, ids = self._fp_and_quantized(8)
+        fp_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
+        q_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(qparams))
+        # attention+MLP kernels dominate; embed/lm_head stay fp32
+        assert q_bytes < 0.7 * fp_bytes
+
+
+class TestLoadCheckpointQuantized:
+    def _save_tiny(self, tmp_path):
+        from accelerate_tpu import Accelerator
+
+        cfg = TransformerConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+        model = Transformer(cfg)
+        ids = jnp.ones((1, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        acc = Accelerator()
+        acc.save_model(params, str(tmp_path))
+        return cfg, model, params
+
+    def test_load_quantized_sharded(self, tmp_path):
+        import dataclasses
+
+        cfg, model, params = self._save_tiny(tmp_path)
+        qparams, dm, loader = load_checkpoint_and_dispatch(
+            None, str(tmp_path), device_map="sharded", quantization=Int8Config()
+        )
+        qmodel = Transformer(dataclasses.replace(cfg, quantization=8))
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+        ref = model.apply({"params": params}, ids)
+        got = qmodel.apply({"params": qparams}, ids)
+        p_ref = jax.nn.softmax(ref, axis=-1)
+        p_got = jax.nn.softmax(got, axis=-1)
+        assert 0.5 * float(jnp.abs(p_ref - p_got).sum(-1).mean()) < 0.05
+
+    def test_auto_map_sees_quantized_sizes(self, tmp_path):
+        cfg, model, params = self._save_tiny(tmp_path)
+        # budget below fp32 size but above int8 size for every module: only the
+        # quantized load fits on device without spilling
+        from accelerate_tpu.utils.modeling import compute_module_sizes, flatten_tree
+
+        _, dm, loader = load_checkpoint_and_dispatch(
+            None, str(tmp_path), device_map="auto", quantization=Int8Config()
+        )
+        assert all(v != "disk" for v in dm.values())
+
+    def test_disk_with_quantization_rejected(self, tmp_path):
+        cfg, model, params = self._save_tiny(tmp_path)
+        with pytest.raises(ValueError, match="disk"):
+            load_checkpoint_and_dispatch(
+                None, str(tmp_path),
+                device_map={m: "disk" for m in params},
+                offload_folder=str(tmp_path / "off"),
+                quantization=Int8Config(),
+            )
+
+
+class TestEstimateQuantized:
+    def test_int8_row_halves_bf16(self):
+        from accelerate_tpu.commands.estimate import DTYPE_BYTES, estimate_training_usage
+
+        assert DTYPE_BYTES["int8"] * 2 == DTYPE_BYTES["bf16"]
+        assert DTYPE_BYTES["int4"] * 4 == DTYPE_BYTES["bf16"]
