@@ -24,12 +24,14 @@ from .data_loader import (
     skip_first_batches,
 )
 from .big_modeling import (
+    StreamingExecutor,
     StreamingTransformer,
     cpu_offload,
     disk_offload,
     dispatch_params,
     init_empty_weights,
     load_checkpoint_and_dispatch,
+    make_layer_plan,
     shard_params_for_inference,
 )
 from .launchers import debug_launcher, notebook_launcher

@@ -4,17 +4,29 @@ Mirrors the reference's primary target workload (BASELINE.json: BERT-base
 GLUE/MRPC via ``examples/nlp_example.py`` — seq 128 classification-scale
 training).  We train a BERT-base-sized (~110M param) transformer with the
 framework's compiled train step (bf16, grad clip, adamw) and report
-samples/sec/chip.
+samples/sec/chip, plus MFU against the detected chip's peak.
 
-``vs_baseline`` compares against an A100 80GB running the same-size model in
-fp16 with HF Accelerate+torch (~650 samples/s for BERT-base seq128 — the
-"≥ A100 step-time" bar from BASELINE.md).
+Baseline derivation (the ``vs_baseline`` denominator): the bar from
+BASELINE.md is "≥ A100 step-time" on this workload.  A100 80GB peak is
+312 TFLOP/s (fp16/bf16, dense).  BERT-base fwd+bwd costs ~6·N·S FLOPs/sample
+= 6 · 110e6 · 128 ≈ 8.45e10, so the A100 roofline is ~3700 samples/s at 100%
+MFU.  Eager-mode HF Accelerate + torch.cuda.amp on this class of short-seq
+model sustains ~15-20% MFU in public fine-tuning benchmarks (small kernels,
+no fusion, python step overhead) → 550-750 samples/s; we take 650 (≈17.6%
+A100 MFU) as the reference point.  Beating it at higher MFU on a smaller
+chip is the honest win condition.
+
+Run ``python bench_inference.py`` for the big-model streaming-inference
+benchmark (tokens/s, the reference ``benchmarks/big_model_inference.py``
+analog), and ``python bench.py --task mrpc`` to time the actual
+examples/nlp_example.py task instead of the synthetic LM proxy.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -23,15 +35,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-A100_BASELINE_SAMPLES_PER_SEC = 650.0
+A100_BASELINE_SAMPLES_PER_SEC = 650.0  # derivation in module docstring
 
 BATCH = 64
 SEQ = 128
 WARMUP = 5
 STEPS = 20
 
+# bf16 dense peak TFLOP/s by device kind (public spec sheets).  Used for MFU;
+# unknown kinds fall back to None and MFU is omitted rather than guessed.
+CHIP_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5e": 197.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6e": 918.0,
+    "TPU v6 lite": 918.0,
+}
 
-def main():
+
+def detect_peak_tflops() -> float | None:
+    kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    for name, peak in CHIP_PEAK_TFLOPS.items():
+        if kind.lower().startswith(name.lower()) or name.lower() in kind.lower():
+            return peak
+    return None
+
+
+def bench_lm_proxy():
+    """BERT-base-geometry causal-LM training step (the default headline)."""
     import optax
 
     import accelerate_tpu as at
@@ -62,18 +95,36 @@ def main():
     batch = {"input_ids": ids}
     for _ in range(WARMUP):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    # block_until_ready is unreliable over tunneled TPU transports; a scalar
+    # D2H materialization is the portable completion barrier.
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     samples_per_sec = BATCH * STEPS / dt
     per_chip = samples_per_sec / n_chips
     # 6*N FLOPs per token (fwd+bwd) — standard transformer estimate.
     tflops = 6 * n_params * SEQ * samples_per_sec / 1e12
+    peak = detect_peak_tflops()
+
+    detail = {
+        "params": n_params,
+        "batch": BATCH,
+        "seq": SEQ,
+        "chips": n_chips,
+        "step_ms": round(1e3 * dt / STEPS, 2),
+        "model_tflops_per_sec": round(tflops, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "baseline": "A100-80GB fp16 eager HF Accelerate ~650 samples/s (see docstring)",
+    }
+    if peak is not None:
+        detail["chip_peak_tflops"] = peak
+        detail["mfu"] = round(tflops / n_chips / peak, 4)
 
     print(
         json.dumps(
@@ -82,18 +133,72 @@ def main():
                 "value": round(per_chip, 2),
                 "unit": "samples/s/chip",
                 "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
-                "detail": {
-                    "params": n_params,
-                    "batch": BATCH,
-                    "seq": SEQ,
-                    "chips": n_chips,
-                    "step_ms": round(1e3 * dt / STEPS, 2),
-                    "model_tflops_per_sec": round(tflops, 1),
-                    "platform": jax.devices()[0].platform,
-                },
+                "detail": detail,
             }
         )
     )
+
+
+def bench_mrpc(epochs: int = 3):
+    """Time the real examples/nlp_example.py task (text-pair classification on
+    the checked-in dataset) — the literal BASELINE.md workload."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples"))
+    import optax
+
+    import accelerate_tpu as at
+    from nlp_example import MAX_LEN, EncoderClassifier, get_dataloaders
+
+    acc = at.Accelerator(mixed_precision="bf16")
+    train_dl, eval_dl = get_dataloaders(acc, batch_size=32)
+    model = EncoderClassifier()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, MAX_LEN), jnp.int32))["params"]
+    state = acc.create_train_state(params=params, tx=optax.adamw(2e-4), seed=0)
+
+    def loss_fn(p, batch, rng=None):
+        logits = model.apply({"params": p}, batch["input_ids"])
+        import optax as _optax
+
+        return _optax.softmax_cross_entropy(logits, jax.nn.one_hot(batch["labels"], 2)).mean()
+
+    step = acc.compile_train_step(loss_fn, max_grad_norm=1.0)
+    # warmup epoch compiles
+    for batch in train_dl:
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # D2H barrier (block_until_ready unreliable on tunnels)
+
+    n_samples = 0
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            n_samples += batch["input_ids"].shape[0]
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    per_chip = n_samples / dt / len(jax.devices())
+    print(
+        json.dumps(
+            {
+                "metric": "mrpc_train_samples_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
+                "detail": {"epochs": epochs, "samples": n_samples, "final_loss": float(metrics["loss"])},
+            }
+        )
+    )
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--task", choices=["lm", "mrpc"], default="lm")
+    args = parser.parse_args()
+    if args.task == "mrpc":
+        bench_mrpc()
+    else:
+        bench_lm_proxy()
 
 
 if __name__ == "__main__":
