@@ -391,6 +391,9 @@ class StreamingExecutor:
         self.pack_transfers = pack_transfers
         self._jit_cache: Dict[Any, Callable] = {}
         self._packed_cache: Dict[int, Any] = {}
+        # (dtype, leaf-ids) -> (pinned leaf refs, packed host buffer); deduped
+        # across stages so shared modules (tied embeddings) snapshot once
+        self._buffer_registry: Dict[Any, Any] = {}
 
     # -- module weight access ---------------------------------------------
     def _stage_params(self, source):
@@ -430,10 +433,34 @@ class StreamingExecutor:
     # -- packed transfer ----------------------------------------------------
     def invalidate_cache(self) -> None:
         """Drop cached packed host buffers.  Call after mutating host weights
-        in place — packed stages are *snapshots* taken at first transfer."""
+        in place — packed stages are *snapshots* taken at first transfer.
+        (Rebinding ``params`` to NEW arrays is detected automatically: cache
+        validity is leaf *identity*, and cached entries pin their source
+        leaves so ids cannot be recycled.)"""
         self._packed_cache.clear()
+        self._buffer_registry.clear()
 
-    def _prepare_stage(self, i: int):
+    def _packed_buffer(self, dtype, group_leaves):
+        """Snapshot one dtype-group into a contiguous host buffer, deduped
+        across stages: modules shared between stages (e.g. a tied embedding
+        table used by both the embed and head stages) pack ONCE.
+
+        The registry entry pins the source leaf objects, which both keeps the
+        id-based key sound (no id recycling while cached) and makes a params
+        rebind an automatic cache miss.
+        """
+        gkey = (np.dtype(dtype), tuple(id(x) for x in group_leaves))
+        entry = self._buffer_registry.get(gkey)
+        if entry is not None and all(a is b for a, b in zip(entry[0], group_leaves)):
+            return entry[1]
+        arrs = [np.asarray(x).reshape(-1) for x in group_leaves]
+        # explicit copy even for a single leaf: every packed buffer is a
+        # snapshot, never a live view of caller memory
+        buffer = np.concatenate(arrs) if len(arrs) > 1 else arrs[0].copy()
+        self._buffer_registry[gkey] = (tuple(group_leaves), buffer)
+        return buffer
+
+    def _prepare_stage(self, i: int, transfer_cache: Optional[Dict[int, Any]] = None):
         """Resolve stage ``i``'s params and issue its (async) transfer.
 
         Returns ``(device_operand, spec_key, treedef)`` where ``spec_key`` is
@@ -442,10 +469,10 @@ class StreamingExecutor:
         Packing applies only to stages whose every leaf is true host data
         (numpy etc., as produced by loaders/checkpoint reads) — jax Arrays are
         already device-resident (or one cheap device_put away) and take the
-        unpacked path.  Packed buffers are consistent SNAPSHOTS: every leaf is
-        copied into the contiguous buffer, and the per-stage cache is keyed on
-        leaf identity+layout; in-place host mutations therefore require
-        :meth:`invalidate_cache`.
+        unpacked path.  Packed buffers are consistent SNAPSHOTS keyed on leaf
+        identity (sources pinned, so identity is sound); in-place host
+        mutations require :meth:`invalidate_cache`.  ``transfer_cache`` dedupes
+        H2D transfers of the same buffer within one forward (tied modules).
         """
         tree = self._stage_params(self.plan[i][0])
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -455,32 +482,36 @@ class StreamingExecutor:
         if not host:
             return self._to_device(tree), None, None
 
-        key = tuple((id(x), getattr(x, "shape", None)) for x in leaves)
         cached = self._packed_cache.get(i)
-        if cached is None or cached[0] != key:
-            # group leaves by dtype; one contiguous host buffer per group
+        if cached is None or len(cached[0]) != len(leaves) or not all(
+            a is b for a, b in zip(cached[0], leaves)
+        ):
+            # group leaves by dtype; one deduped contiguous buffer per group
             groups: Dict[Any, list] = {}
-            spec = []
+            placements = []
             for leaf in leaves:
                 arr = np.asarray(leaf)
                 g = groups.setdefault(arr.dtype, [])
-                offset = sum(a.size for a in g)
-                g.append(arr.reshape(-1))
-                spec.append((arr.dtype, offset, arr.size, arr.shape))
+                offset = sum(a.size for _, a in g)
+                g.append((leaf, arr))
+                placements.append((arr.dtype, offset, arr.size, arr.shape))
             dtypes = list(groups)
-            # np.concatenate copies even for one input only when forced: make
-            # the single-leaf case an explicit copy too, so every packed stage
-            # is a snapshot (never a live view of caller memory)
             buffers = [
-                np.concatenate(groups[d]) if len(groups[d]) > 1 else groups[d][0].copy()
-                for d in dtypes
+                self._packed_buffer(d, [leaf for leaf, _ in groups[d]]) for d in dtypes
             ]
             spec = tuple(
-                (dtypes.index(d), off, size, shape) for (d, off, size, shape) in spec
+                (dtypes.index(d), off, size, shape) for (d, off, size, shape) in placements
             )
-            self._packed_cache[i] = cached = (key, buffers, spec)
+            self._packed_cache[i] = cached = (tuple(leaves), buffers, spec)
         _, buffers, spec = cached
-        dev_buffers = [jax.device_put(b, self.device) for b in buffers]
+        dev_buffers = []
+        for b in buffers:
+            dev = transfer_cache.get(id(b)) if transfer_cache is not None else None
+            if dev is None:
+                dev = jax.device_put(b, self.device)
+                if transfer_cache is not None:
+                    transfer_cache[id(b)] = dev
+            dev_buffers.append(dev)
         return dev_buffers, spec, treedef
 
     def _run_stage(self, fn, operand, spec, treedef, carry):
@@ -502,12 +533,13 @@ class StreamingExecutor:
     # -- forward -----------------------------------------------------------
     def __call__(self, *inputs):
         carry: Tuple[Any, ...] = inputs
-        current = self._prepare_stage(0)
+        transfer_cache: Dict[int, Any] = {}  # per-call H2D dedupe (tied modules)
+        current = self._prepare_stage(0, transfer_cache)
         for i, (source, fn) in enumerate(self.plan):
             nxt = None
             if i + 1 < len(self.plan):
                 # async transfer of stage i+1 issued before stage i computes
-                nxt = self._prepare_stage(i + 1)
+                nxt = self._prepare_stage(i + 1, transfer_cache)
             operand, spec, treedef = current
             out = self._run_stage(fn, operand, spec, treedef, carry)
             carry = out if isinstance(out, tuple) else (out,)
@@ -555,7 +587,8 @@ class StreamingTransformer(StreamingExecutor):
         self._scan_layout = bool(getattr(cfg, "scan_layers", False)) or (
             isinstance(params, dict) and "layers" in params and "layers_0" not in params
         )
-        self._stack_cache = None  # per-forward cache of the scanned layer stack
+        self._stack_cache = None  # cached scanned-layer stack (invalidate_cache resets)
+        self._slice_cache: Dict[int, Any] = {}  # per-layer slice trees of the stack
         # layers_per_stage > 1 amortizes per-dispatch/per-transfer fixed costs
         # (dominant on high-latency transports) over bigger chunks; choose so
         # ~2 chunks fit in free HBM alongside activations.
@@ -604,18 +637,32 @@ class StreamingTransformer(StreamingExecutor):
         )
         super().__init__(plan, params=params, weights_loader=weights_loader, exec_device=exec_device)
 
+    def invalidate_cache(self) -> None:
+        self._stack_cache = None
+        self._slice_cache = {}
+        super().invalidate_cache()
+
     def _layer_params(self, i: int):
         if not self._scan_layout:
             return self._module_params(f"layers_{i}")
-        # fetch the stacked module once per forward (a loader read is a full
-        # eager deserialize — O(layers) re-reads would defeat the streaming)
+        # fetch the stacked module once (a loader read is a full eager
+        # deserialize — O(layers) re-reads would defeat the streaming), and
+        # keep the per-layer slice trees across calls: stable slice identity
+        # is what lets the executor's packed cache hit instead of re-packing
+        # the whole model every forward.  Swapping self.params requires
+        # invalidate_cache(), same as every packed-cache path.
         if self._stack_cache is None:
             self._stack_cache = self._module_params("layers")["layer"]
-        return jax.tree_util.tree_map(lambda x: x[i], self._stack_cache)
+            self._slice_cache = {}
+        cached = self._slice_cache.get(i)
+        if cached is None:
+            cached = self._slice_cache[i] = jax.tree_util.tree_map(
+                lambda x: x[i], self._stack_cache
+            )
+        return cached
 
     def __call__(self, input_ids, positions=None):
         input_ids = jnp.asarray(input_ids)
-        self._stack_cache = None  # params may have been swapped between calls
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1])[None, :], input_ids.shape)
         return super().__call__(input_ids, positions)

@@ -2,7 +2,12 @@
 
 from .moe import MoEMLP, router_aux_loss, shard_moe_params, top_k_dispatch
 from .pipeline import pipeline_apply, prepare_pipeline, stack_layer_params
-from .ring_attention import ring_attention, ring_attention_sharded
+from .ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ring_attention_zigzag,
+    zigzag_permutation,
+)
 from .mesh import (
     DATA_AXES,
     MESH_AXES,

@@ -328,6 +328,33 @@ class TestStreamingExecutor:
         ex.invalidate_cache()
         np.testing.assert_allclose(np.asarray(ex(x)), 0.0)
 
+    def test_params_rebind_is_detected(self):
+        # review finding: cache keys must pin their leaves so recycled object
+        # ids can never serve stale weights after a params swap
+        from accelerate_tpu import StreamingExecutor
+
+        plan = [("mod", lambda p, x: x @ p["w"])]
+        ex = StreamingExecutor(plan, params={"mod": {"w": np.ones((8, 8), np.float32)}})
+        x = jnp.ones((2, 8))
+        np.testing.assert_allclose(np.asarray(ex(x)), 8.0)
+        for scale in (2.0, 3.0, 5.0):
+            # fresh arrays each time — many chances for id reuse
+            ex.params = {"mod": {"w": np.full((8, 8), scale, np.float32)}}
+            np.testing.assert_allclose(np.asarray(ex(x)), 8.0 * scale)
+
+    def test_tied_module_packs_once(self):
+        from accelerate_tpu import StreamingExecutor
+
+        shared = {"w": np.ones((32, 32), np.float32)}
+        plan = [
+            ("a", lambda p, x: x @ p["w"]),
+            (lambda: shared, lambda p, x: x @ p["w"]),
+        ]
+        ex = StreamingExecutor(plan, params={"a": shared})
+        ex(jnp.ones((2, 32)))
+        # both stages share ONE snapshot buffer in the registry
+        assert len(ex._buffer_registry) == 1
+
     def test_jax_array_params_take_unpacked_path(self):
         from accelerate_tpu import StreamingExecutor
 

@@ -88,3 +88,81 @@ def test_dispatch_error_points_to_ring(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="ring_attention_sharded"):
         dot_product_attention(q, k, v, implementation="ring")
+
+
+class TestZigzag:
+    """Balanced causal layout: numeric equality with the contiguous path."""
+
+    def test_matches_contiguous_and_full(self, mesh, qkv):
+        q, k, v = qkv
+        zz = ring_attention_sharded(q, k, v, mesh, causal=True, layout="zigzag")
+        contig = ring_attention_sharded(q, k, v, mesh, causal=True)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(zz), np.asarray(contig), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(zz), np.asarray(ref), atol=2e-5)
+
+    def test_gqa(self, mesh, qkv):
+        rng = np.random.default_rng(1)
+        q = qkv[0]
+        k = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, layout="zigzag")
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_segment_ids(self, mesh, qkv):
+        q, k, v = qkv
+        seg = jnp.concatenate(
+            [jnp.zeros((B, S // 2), jnp.int32), jnp.ones((B, S // 2), jnp.int32)], axis=1
+        )
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, segment_ids=seg, layout="zigzag")
+        ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_gradients(self, mesh, qkv):
+        q, k, v = qkv
+        g1 = jax.grad(
+            lambda *a: (ring_attention_sharded(*a, mesh, causal=True, layout="zigzag") ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda *a: (dot_product_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            scale = max(float(jnp.abs(b).max()), 1.0)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5 * scale)
+
+    def test_remat(self, mesh, qkv):
+        q, k, v = qkv
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, layout="zigzag", remat=True)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_non_causal_falls_back(self, mesh, qkv):
+        q, k, v = qkv
+        out = ring_attention_sharded(q, k, v, mesh, causal=False, layout="zigzag")
+        ref = dot_product_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_permutation_roundtrip(self):
+        from accelerate_tpu.parallel.ring_attention import (
+            inverse_zigzag_permutation,
+            zigzag_permutation,
+        )
+
+        perm = np.asarray(zigzag_permutation(16, 4))
+        inv = np.asarray(inverse_zigzag_permutation(16, 4))
+        # shard 0 holds chunks 0 and 7 (chunk size 2)
+        assert list(perm[:4]) == [0, 1, 14, 15]
+        np.testing.assert_array_equal(perm[inv], np.arange(16))
+
+    def test_bad_seq_len_raises(self):
+        from accelerate_tpu.parallel.ring_attention import zigzag_permutation
+
+        with pytest.raises(ValueError, match="seq_len"):
+            zigzag_permutation(10, 4)
+
+    def test_bad_layout_name(self, mesh, qkv):
+        q, k, v = qkv
+        with pytest.raises(ValueError, match="layout"):
+            ring_attention_sharded(q, k, v, mesh, layout="striped")
