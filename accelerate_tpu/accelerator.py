@@ -1146,7 +1146,9 @@ class Accelerator:
         yield
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
-        return model
+        from .utils.other import extract_model_from_parallel
+
+        return extract_model_from_parallel(model, keep_fp32_wrapper)
 
     def free_memory(self, *objects):
         """Release compiled/jitted caches and live buffers (reference ``accelerator.py:3158``)."""

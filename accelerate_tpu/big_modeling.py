@@ -64,6 +64,12 @@ def checkpoint_shapes(
     for key, fname in (files if files is not None else _checkpoint_files(checkpoint)).items():
         by_file.setdefault(fname, []).append(key)
     for fname, keys in by_file.items():  # one open + header parse per file
+        if fname.endswith(".bin"):
+            entries = _bin_entries(fname)
+            for key in keys:
+                t = entries[key]
+                flat[key] = jax.ShapeDtypeStruct(tuple(t.shape), _torch_np_dtype(t.dtype))
+            continue
         with safe_open(fname, framework="np") as f:
             for key in keys:
                 sl = f.get_slice(key)
@@ -85,35 +91,96 @@ _SAFETENSORS_DTYPES = {
 
 
 def _checkpoint_files(checkpoint: str) -> Dict[str, str]:
-    """{tensor_name: safetensors file path} for a single-file or sharded
-    (``model.safetensors.index.json``) checkpoint."""
+    """{tensor_name: file path} for a single-file or sharded checkpoint.
+
+    Safetensors is the native format; torch-pickle ``.bin`` checkpoints
+    (``pytorch_model.bin`` / ``pytorch_model.bin.index.json``) are read as a
+    fallback via torch-cpu (reference ``load_checkpoint_in_model`` handles
+    both, ``utils/modeling.py:1608-1830``).
+    """
     import json
 
     if os.path.isfile(checkpoint):
         files = [checkpoint]
-        index = None
     else:
-        index_path = os.path.join(checkpoint, "model.safetensors.index.json")
-        single = os.path.join(checkpoint, "model.safetensors")
-        if os.path.isfile(index_path):
-            with open(index_path) as f:
-                index = json.load(f)
-            return {
-                key: os.path.join(checkpoint, fname)
-                for key, fname in index["weight_map"].items()
-            }
-        elif os.path.isfile(single):
-            files, index = [single], None
+        for index_name in ("model.safetensors.index.json", "pytorch_model.bin.index.json"):
+            index_path = os.path.join(checkpoint, index_name)
+            if os.path.isfile(index_path):
+                with open(index_path) as f:
+                    index = json.load(f)
+                return {
+                    key: os.path.join(checkpoint, fname)
+                    for key, fname in index["weight_map"].items()
+                }
+        for single_name in ("model.safetensors", "pytorch_model.bin"):
+            single = os.path.join(checkpoint, single_name)
+            if os.path.isfile(single):
+                files = [single]
+                break
         else:
-            raise FileNotFoundError(f"No safetensors checkpoint found at {checkpoint}")
-    from safetensors import safe_open
-
+            raise FileNotFoundError(
+                f"No checkpoint found at {checkpoint} (looked for model.safetensors[.index.json] "
+                "and pytorch_model.bin[.index.json])"
+            )
     mapping: Dict[str, str] = {}
     for fname in files:
-        with safe_open(fname, framework="np") as f:
-            for key in f.keys():
+        if fname.endswith(".bin"):
+            for key in _bin_entries(fname):
                 mapping[key] = fname
+        else:
+            from safetensors import safe_open
+
+            with safe_open(fname, framework="np") as f:
+                for key in f.keys():
+                    mapping[key] = fname
     return mapping
+
+
+_BIN_CACHE: Dict[Any, Dict[str, Any]] = {}
+_BIN_CACHE_MAX = 16  # bounds pinned shards; keyed on (path, mtime, size) so a
+                     # rewritten checkpoint is never served stale
+
+
+def _bin_entries(fname: str) -> Dict[str, Any]:
+    """Lazily torch.load a ``.bin`` shard (mmap'd, cpu) -> {key: torch tensor}.
+
+    Cached because torch-pickle has no header-only read: the one load serves
+    both shape inspection and tensor reads (mmap keeps RSS bounded where the
+    format allows).  LRU-capped, invalidated by file mtime/size.
+    """
+    stat = os.stat(fname)
+    key = (fname, stat.st_mtime_ns, stat.st_size)
+    cached = _BIN_CACHE.get(key)
+    if cached is None:
+        import torch
+
+        try:
+            cached = torch.load(fname, map_location="cpu", mmap=True, weights_only=True)
+        except (TypeError, RuntimeError):  # older formats: no mmap / zipfile
+            cached = torch.load(fname, map_location="cpu", weights_only=True)
+        # drop superseded versions of this file, then cap total entries
+        for k in [k for k in _BIN_CACHE if k[0] == fname]:
+            del _BIN_CACHE[k]
+        while len(_BIN_CACHE) >= _BIN_CACHE_MAX:
+            del _BIN_CACHE[next(iter(_BIN_CACHE))]
+        _BIN_CACHE[key] = cached
+    return cached
+
+
+def _torch_to_numpy(t) -> np.ndarray:
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        return t.view(torch.uint16).numpy().view(jnp.bfloat16)
+    return t.numpy()
+
+
+def _torch_np_dtype(td):
+    import torch
+
+    if td == torch.bfloat16:
+        return jnp.bfloat16
+    return np.dtype(str(td).replace("torch.", ""))
 
 
 # ----------------------------------------------------------------- dispatch
@@ -336,6 +403,12 @@ def _read_tensors(files: Dict[str, str], keys, dtype=None) -> Dict[str, np.ndarr
         by_file.setdefault(files[k], []).append(k)
     out: Dict[str, np.ndarray] = {}
     for fname, ks in by_file.items():
+        if fname.endswith(".bin"):
+            entries = _bin_entries(fname)
+            for k in ks:
+                t = _torch_to_numpy(entries[k])
+                out[k] = t.astype(jnp.dtype(dtype)) if dtype is not None else t
+            continue
         with safe_open(fname, framework="np") as f:
             for k in ks:
                 t = f.get_tensor(k)
@@ -502,7 +575,17 @@ class StreamingExecutor:
             spec = tuple(
                 (dtypes.index(d), off, size, shape) for (d, off, size, shape) in placements
             )
+            replaced = cached is not None
             self._packed_cache[i] = cached = (tuple(leaves), buffers, spec)
+            if replaced:
+                # a rebind superseded the old snapshot: drop registry entries no
+                # stage references anymore, or every swap leaks a model copy
+                live = {
+                    id(b) for (_, bufs, _) in self._packed_cache.values() for b in bufs
+                }
+                self._buffer_registry = {
+                    k: v for k, v in self._buffer_registry.items() if id(v[1]) in live
+                }
         _, buffers, spec = cached
         dev_buffers = []
         for b in buffers:
@@ -588,6 +671,7 @@ class StreamingTransformer(StreamingExecutor):
             isinstance(params, dict) and "layers" in params and "layers_0" not in params
         )
         self._stack_cache = None  # cached scanned-layer stack (invalidate_cache resets)
+        self._stack_src = None    # identity of the params["layers"] subtree the cache came from
         self._slice_cache: Dict[int, Any] = {}  # per-layer slice trees of the stack
         # layers_per_stage > 1 amortizes per-dispatch/per-transfer fixed costs
         # (dominant on high-latency transports) over bigger chunks; choose so
@@ -639,6 +723,7 @@ class StreamingTransformer(StreamingExecutor):
 
     def invalidate_cache(self) -> None:
         self._stack_cache = None
+        self._stack_src = None
         self._slice_cache = {}
         super().invalidate_cache()
 
@@ -651,8 +736,10 @@ class StreamingTransformer(StreamingExecutor):
         # is what lets the executor's packed cache hit instead of re-packing
         # the whole model every forward.  Swapping self.params requires
         # invalidate_cache(), same as every packed-cache path.
-        if self._stack_cache is None:
+        stack_src = self.params.get("layers") if isinstance(self.params, dict) else None
+        if self._stack_cache is None or self._stack_src is not stack_src:
             self._stack_cache = self._module_params("layers")["layer"]
+            self._stack_src = stack_src
             self._slice_cache = {}
         cached = self._slice_cache.get(i)
         if cached is None:
@@ -663,6 +750,10 @@ class StreamingTransformer(StreamingExecutor):
 
     def __call__(self, input_ids, positions=None):
         input_ids = jnp.asarray(input_ids)
+        if self._scan_layout and not (isinstance(self.params, dict) and "layers" in self.params):
+            # loader-backed stacks have no identity to validate against — the
+            # loader may serve different bytes each call, so refetch per forward
+            self._stack_cache = None
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1])[None, :], input_ids.shape)
         return super().__call__(input_ids, positions)

@@ -1,19 +1,26 @@
 """Interactive questionnaire building a ClusterConfig (reference
 ``commands/config/cluster.py:49`` ``get_cluster_input``).
 
-Kept deliberately plain (input()/EOF-safe) rather than porting the reference's
-curses-style menu (``commands/menu/``): the questionnaire must work over SSH to
-a pod worker and inside CI, where a TTY is not guaranteed.
+Choice questions render through the arrow-key menu (``commands/menu.py``, the
+reference ``commands/menu/`` analog) on a real TTY, and fall back to plain
+``input()`` over SSH pipes / CI where no TTY exists — the questionnaire must
+never hang a non-interactive session.
 """
 
 from __future__ import annotations
 
+import sys
 from typing import Callable, List, Optional
 
 from .config_args import ClusterConfig, ComputeEnvironment, parse_mesh_spec
 
 
 def _ask(prompt: str, default: str = "", convert: Optional[Callable] = None, choices: Optional[List[str]] = None):
+    if choices and sys.stdin.isatty() and sys.stdout.isatty():
+        from ..menu import select
+
+        raw = select(f"{prompt}:", choices, default=default)
+        return convert(raw) if convert is not None else raw
     suffix = f" [{default}]" if default != "" else ""
     if choices:
         prompt = f"{prompt} ({'/'.join(choices)})"

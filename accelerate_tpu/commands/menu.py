@@ -1,0 +1,102 @@
+"""Interactive arrow-key selection menu for `accelerate-tpu config`.
+
+Reference analog: ``src/accelerate/commands/menu/`` (487 LoC cursor/keymap/
+selection machinery).  Rewritten small: one class, raw-mode arrow/j/k/digit
+navigation with ANSI redraw, and a numbered-``input()`` fallback whenever
+stdin is not a TTY (CI, SSH pipes) — the questionnaire must never hang a
+non-interactive session.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+class BulletMenu:
+    """``BulletMenu(prompt, choices).run(default_index)`` -> chosen index."""
+
+    def __init__(self, prompt: str, choices: List[str]):
+        self.prompt = prompt
+        self.choices = list(choices)
+
+    # ---------------------------------------------------------------- tty io
+    @staticmethod
+    def _read_key() -> str:
+        import select
+        import termios
+        import tty
+
+        fd = sys.stdin.fileno()
+        old = termios.tcgetattr(fd)
+        try:
+            tty.setraw(fd)
+            ch = sys.stdin.read(1)
+            if ch == "\x1b":
+                # bare Escape vs arrow sequence: only read the continuation if
+                # bytes are already pending, else a lone Esc would block here
+                if not select.select([sys.stdin], [], [], 0.05)[0]:
+                    return "esc"
+                seq = sys.stdin.read(2)
+                return {"[A": "up", "[B": "down"}.get(seq, "esc")
+            return ch
+        finally:
+            termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    def _draw(self, selected: int, first: bool):
+        out = sys.stdout
+        if not first:
+            out.write(f"\x1b[{len(self.choices)}A")  # move cursor up N lines
+        for i, choice in enumerate(self.choices):
+            marker = "➤ " if i == selected else "  "
+            style = ("\x1b[7m", "\x1b[0m") if i == selected else ("", "")
+            out.write(f"\x1b[2K{marker}{style[0]}{choice}{style[1]}\n")
+        out.flush()
+
+    # ------------------------------------------------------------------- run
+    def run(self, default: int = 0) -> int:
+        if not sys.stdin.isatty() or not sys.stdout.isatty():
+            return self._run_plain(default)
+        print(self.prompt)
+        selected = default
+        self._draw(selected, first=True)
+        while True:
+            key = self._read_key()
+            if key in ("up", "k"):
+                selected = (selected - 1) % len(self.choices)
+            elif key in ("down", "j"):
+                selected = (selected + 1) % len(self.choices)
+            elif key.isdigit() and int(key) < len(self.choices):
+                selected = int(key)
+            elif key in ("\r", "\n"):
+                return selected
+            elif key in ("\x03", "esc"):  # ctrl-c
+                raise KeyboardInterrupt
+            self._draw(selected, first=False)
+
+    def _run_plain(self, default: int) -> int:
+        """Numbered fallback for non-TTY sessions."""
+        print(self.prompt)
+        for i, choice in enumerate(self.choices):
+            print(f"  [{i}] {choice}")
+        try:
+            raw = input(f"Choice [{default}]: ").strip()
+        except EOFError:
+            raw = ""
+        if raw == "":
+            return default
+        try:
+            idx = int(raw)
+            if 0 <= idx < len(self.choices):
+                return idx
+        except ValueError:
+            if raw in self.choices:
+                return self.choices.index(raw)
+        print(f"  invalid choice {raw!r}, using {default}")
+        return default
+
+
+def select(prompt: str, choices: List[str], default: Optional[str] = None) -> str:
+    """Convenience: run a menu, return the chosen STRING."""
+    default_index = choices.index(default) if default in choices else 0
+    return choices[BulletMenu(prompt, choices).run(default_index)]

@@ -16,6 +16,8 @@ def test_command_parser(subparsers=None):
         parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
     parser.add_argument("--config_file", default=None, help="Config from `accelerate-tpu config`.")
     parser.add_argument("--cpu", action="store_true", help="Run the self-test on CPU.")
+    parser.add_argument("--num_processes", type=int, default=1,
+                        help="CPU debug mode: run the self-test across N local processes.")
     if subparsers is not None:
         parser.set_defaults(func=test_command)
     return parser
@@ -27,10 +29,10 @@ def test_command(args):
     script = os.path.abspath(test_script.__file__)
     from .launch import launch_command, launch_command_parser
 
-    launch_args = ["--num_processes", "1"]
+    launch_args = ["--num_processes", str(args.num_processes)]
     if args.config_file:
         launch_args += ["--config_file", args.config_file]
-    if args.cpu:
+    if args.cpu or args.num_processes > 1:
         launch_args += ["--cpu"]
     launch_args.append(script)
     parsed = launch_command_parser().parse_args(launch_args)

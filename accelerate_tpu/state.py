@@ -76,9 +76,13 @@ class PartialState:
         if cpu:
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
         # Multi-host rendezvous (reference: init_process_group, state.py:212,255).
+        # NOTE: the guard must NOT call jax.process_count() — that initializes
+        # the XLA backend, after which jax.distributed.initialize refuses to
+        # run.  jax.distributed.is_initialized() is backend-free.
         coordinator = os.environ.get(ENV_COORDINATOR)
         want_procs = int(os.environ.get(ENV_NUM_PROCESSES, "0") or 0)
-        if coordinator and want_procs > 1 and jax.process_count() == 1:
+        already = jax.distributed.is_initialized() if hasattr(jax.distributed, "is_initialized") else False
+        if coordinator and want_procs > 1 and not already:
             timeout = kwargs.pop("timeout", None)
             init_kwargs = dict(
                 coordinator_address=coordinator,

@@ -1,0 +1,96 @@
+"""Bundled checkpoint save/resume-mid-epoch integration script.
+
+Reference analog: ``test_utils/scripts/external_deps/test_checkpointing.py``
+and ``tests/test_state_checkpointing.py`` — run under the real launcher
+(tier 3) to verify that a training run interrupted mid-epoch and resumed in a
+FRESH process continues exactly where it left off.
+
+Modes (``--mode``):
+  * ``full``    — train 2 epochs uninterrupted; write final params to
+                  ``<dir>/full.npz``.
+  * ``save``    — train 1 epoch + ``--resume_step`` batches of epoch 2, then
+                  ``save_state`` and exit (the "crash").
+  * ``resume``  — fresh process: ``load_state``, ``skip_first_batches``, finish
+                  epoch 2; write final params to ``<dir>/resumed.npz``.
+
+The runner asserts ``full.npz == resumed.npz``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def build(accelerator):
+    import optax
+
+    from accelerate_tpu import SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionModel, regression_dataset
+
+    data = regression_dataset(64)
+    dl = accelerator.prepare(
+        SimpleDataLoader(data, batch_size=16, shuffle=True, seed=7)
+    )
+    state = accelerator.create_train_state(
+        params=RegressionModel().init_params(), tx=optax.adam(2e-2), seed=0
+    )
+    step = accelerator.compile_train_step(RegressionModel.loss_fn, donate=False)
+    return dl, state, step
+
+
+def dump(accelerator, state, path):
+    if accelerator.is_main_process:
+        host = {k: np.asarray(v) for k, v in state.params.items()}
+        np.savez(path, **host)
+    accelerator.wait_for_everyone()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", choices=["full", "save", "resume"], required=True)
+    parser.add_argument("--dir", required=True)
+    parser.add_argument("--resume_step", type=int, default=2)
+    args = parser.parse_args()
+
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    dl, state, step = build(accelerator)
+    ckpt = os.path.join(args.dir, "ckpt")
+    steps_per_epoch = len(dl)
+
+    if args.mode in ("full", "save"):
+        dl.set_epoch(0)
+        for batch in dl:
+            state, _ = step(state, batch)
+        if args.mode == "full":
+            dl.set_epoch(1)
+            for batch in dl:
+                state, _ = step(state, batch)
+            dump(accelerator, state, os.path.join(args.dir, "full.npz"))
+            print("full run done")
+            return
+        # save: run `resume_step` batches into epoch 2, checkpoint, "crash"
+        dl.set_epoch(1)
+        it = iter(dl)
+        for _ in range(args.resume_step):
+            state, _ = step(state, next(it))
+        accelerator.save_state(ckpt, state=state)
+        print(f"saved at epoch 1 step {args.resume_step}")
+        return
+
+    # resume in a FRESH process: restore and finish epoch 2
+    state = accelerator.load_state(ckpt, state=state)
+    dl.set_epoch(1)
+    resumed = accelerator.skip_first_batches(dl, args.resume_step)
+    for batch in resumed:
+        state, _ = step(state, batch)
+    dump(accelerator, state, os.path.join(args.dir, "resumed.npz"))
+    print("resumed run done")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,24 @@ from __future__ import annotations
 import numpy as np
 
 
+def host_value(x):
+    """Local host view of a possibly-global array: replicated arrays read one
+    replica, sharded arrays concatenate this process's shards (dim 0)."""
+    import jax
+
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # device-enumeration order is not shard order: sort by global offset
+        shards = sorted(
+            x.addressable_shards,
+            key=lambda s: tuple(sl.start or 0 for sl in s.index),
+        )
+        arrays = [np.asarray(s.data) for s in shards]
+        if x.sharding.is_fully_replicated:
+            return arrays[0]
+        return np.concatenate(arrays)
+    return np.asarray(x)
+
+
 def process_execution_check(accelerator):
     state = accelerator.state
     assert state.num_processes >= 1
@@ -42,7 +60,7 @@ def dl_preparation_check(accelerator):
     dl = accelerator.prepare(SimpleDataLoader(data, batch_size=8))
     seen = []
     for batch in dl:
-        seen.append(np.asarray(batch["x"]).reshape(-1))
+        seen.append(host_value(batch["x"]).reshape(-1))
     total = np.concatenate(seen)
     # every index must appear across the epoch (per process view covers the epoch)
     assert len(total) >= 32 // max(accelerator.num_processes, 1)
@@ -74,10 +92,71 @@ def training_check(accelerator):
     for _ in range(40):
         for batch in dl:
             state, metrics = step(state, batch)
-    final = float(metrics["loss"])
+    final = float(host_value(metrics["loss"]))
     assert final < 1e-3, f"training did not converge: loss={final}"
-    np.testing.assert_allclose(np.asarray(state.params["w"]), W, atol=0.05)
+    np.testing.assert_allclose(host_value(state.params["w"]), W, atol=0.05)
     print(f"[{accelerator.process_index}] training convergence: OK (loss={final:.2e})")
+
+
+def distributed_vs_single_check(accelerator):
+    """Distributed training must produce the SAME per-step losses as a plain
+    single-device loop over the same global batches (reference
+    ``test_script.py:420`` training_check compares distributed vs single).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import SimpleDataLoader
+    from accelerate_tpu.test_utils.training import RegressionModel, regression_dataset
+
+    data = regression_dataset(64)
+    model = RegressionModel()
+
+    # ground truth: hand-rolled single-device loop over the same GLOBAL batches
+    # (batch_size is per-process — reference split_batches=False semantics — so
+    # the global batch is 16 * num_processes)
+    gb = 16 * max(accelerator.num_processes, 1)
+    X = jnp.asarray(np.stack([d["x"] for d in data]))
+    Y = jnp.asarray(np.stack([d["y"] for d in data]))
+    tx = optax.sgd(0.05)
+    params = model.init_params()
+    opt_state = tx.init(params)
+    ref_losses = []
+
+    @jax.jit
+    def ref_step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.mean((RegressionModel.apply(p, xb) - yb) ** 2)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for epoch in range(2):
+        for start in range(0, 64, gb):
+            params, opt_state, loss = ref_step(
+                params, opt_state, X[start : start + gb], Y[start : start + gb]
+            )
+            ref_losses.append(float(loss))
+
+    # distributed: same global batches through the accelerator
+    dl = accelerator.prepare(SimpleDataLoader(data, batch_size=16, shuffle=False))
+    state = accelerator.create_train_state(params=model.init_params(), tx=optax.sgd(0.05))
+    step = accelerator.compile_train_step(RegressionModel.loss_fn, donate=False)
+    dist_losses = []
+    for epoch in range(2):
+        for batch in dl:
+            state, metrics = step(state, batch)
+            dist_losses.append(float(host_value(metrics["loss"])))
+
+    np.testing.assert_allclose(np.asarray(dist_losses), np.asarray(ref_losses), rtol=1e-4)
+    np.testing.assert_allclose(
+        host_value(state.params["a"]), np.asarray(params["a"]), rtol=1e-4
+    )
+    print(
+        f"[{accelerator.process_index}] distributed == single-process losses: OK "
+        f"({len(dist_losses)} steps)"
+    )
 
 
 def main():
@@ -89,6 +168,7 @@ def main():
     collectives_check(accelerator)
     dl_preparation_check(accelerator)
     training_check(accelerator)
+    distributed_vs_single_check(accelerator)
     accelerator.print("All self-tests passed.")
 
 

@@ -1,0 +1,59 @@
+"""Shared test fixtures — the ``RegressionDataset``/``RegressionModel`` analog
+(reference ``src/accelerate/test_utils/training.py:22-62``) plus the mocked
+dataloaders over the checked-in example dataset (``training.py:65``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def regression_dataset(length: int = 96, seed: int = 42) -> List[dict]:
+    """``y = 2x + 3 + noise`` sample dicts (reference ``RegressionDataset``)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(length, 1)).astype(np.float32)
+    y = 2.0 * x + 3.0 + 0.05 * rng.normal(size=(length, 1)).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(length)]
+
+
+class RegressionModel:
+    """``a * x + b`` with scalar params (reference ``RegressionModel``): the
+    smallest model whose convergence target (a→2, b→3) is known in closed form.
+    Functional style: ``init_params()`` + ``apply(params, x)``.
+    """
+
+    def __init__(self, a: float = 0.0, b: float = 0.0):
+        self.a0, self.b0 = float(a), float(b)
+
+    def init_params(self):
+        return {"a": jnp.asarray([self.a0]), "b": jnp.asarray([self.b0])}
+
+    @staticmethod
+    def apply(params, x):
+        return x * params["a"] + params["b"]
+
+    @staticmethod
+    def loss_fn(params, batch, rng=None):
+        pred = RegressionModel.apply(params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def mocked_dataloaders(accelerator, batch_size: int = 16) -> Tuple:
+    """Train/eval loaders over the checked-in examples dataset (reference
+    ``mocked_dataloaders`` over ``tests/test_samples/MRPC``)."""
+    import os
+    import sys
+
+    examples_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "examples",
+    )
+    if examples_dir not in sys.path:
+        sys.path.insert(0, examples_dir)
+    from nlp_example import get_dataloaders
+
+    return get_dataloaders(accelerator, batch_size=batch_size)

@@ -78,4 +78,15 @@ from .memory import (
     release_memory,
     should_reduce_batch_size,
 )
+from .other import (
+    check_os_kernel,
+    clear_environment,
+    convert_bytes,
+    extract_model_from_parallel,
+    is_port_in_use,
+    merge_dicts,
+    patch_environment,
+    save,
+)
 from .random import make_rng_key, set_seed, synchronize_rng_state, synchronize_rng_states
+from .tqdm import tqdm

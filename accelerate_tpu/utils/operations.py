@@ -263,13 +263,25 @@ def chained_operation(function):
 
 # ----------------------------------------------------------------- collectives
 def _gather_one(x):
-    """Materialize the full value of one tensor on every process."""
-    if isinstance(x, jax.Array):
-        if x.is_fully_addressable:
-            return np.asarray(jax.device_get(x))
-        return np.asarray(multihost_utils.process_allgather(x))
-    x = _to_numpy(x)
+    """Materialize the full value of one tensor on every process.
+
+    Three cases: a non-fully-addressable array is a sharded GLOBAL value
+    (multi-host mesh) — process_allgather assembles it; a fully-addressable
+    array under multiple processes is a process-LOCAL value — ranks' values
+    concatenate on dim 0 (reference gather semantics, per-rank [b,...] ->
+    [world*b,...]); single process just reads it.
+    """
     state = PartialState()
+    if isinstance(x, jax.Array):
+        if not x.is_fully_addressable:
+            # jax requires tiled=True for global non-fully-addressable arrays;
+            # it returns the assembled global value on every process
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        x = np.asarray(jax.device_get(x))
+        if state.num_processes == 1:
+            return x
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    x = _to_numpy(x)
     if state.num_processes == 1:
         return x
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
@@ -377,14 +389,26 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
         state = PartialState()
         if isinstance(x, jax.Array):
             n = _num_shards_of(x)
-            full = _gather_one(x)
-            if n > 1 and full.shape and full.shape[0] % n == 0:
-                stacked = full.reshape((n, full.shape[0] // n) + full.shape[1:])
-                out = stacked.sum(axis=0) * scale
-                if reduction == "mean":
-                    out = out / n
-                return out
-            return full * scale
+            if n > 1:
+                # sharded global array: fold the shard (data) dimension
+                full = _gather_one(x)
+                if full.shape and full.shape[0] % n == 0:
+                    stacked = full.reshape((n, full.shape[0] // n) + full.shape[1:])
+                    out = stacked.sum(axis=0) * scale
+                    if reduction == "mean":
+                        out = out / n
+                    return out
+                return full * scale
+            if not x.is_fully_addressable:
+                # replicated global array: every rank already holds the reduced
+                # value (XLA reduced it inside the step) — read the local
+                # replica, do NOT sum across processes again
+                if x.sharding.is_fully_replicated:
+                    return np.asarray(next(iter(x.addressable_shards)).data) * scale
+                return _gather_one(x) * scale
+            # single-shard (process-local) array: elementwise reduce across
+            # processes, exactly like a host value — shape is preserved
+            x = np.asarray(jax.device_get(x))
         x = _to_numpy(x)
         if state.num_processes == 1:
             return x * scale

@@ -1,0 +1,147 @@
+"""Misc utilities — reference ``src/accelerate/utils/other.py`` parity.
+
+Implemented here: ``patch_environment``/``clear_environment`` (``:211-246``),
+``extract_model_from_parallel`` (``:56``), ``check_os_kernel`` (``:334``),
+``save`` (``:176``), ``merge_dicts``, ``is_port_in_use`` (``utils/launch.py:
+179-185`` pre-check), ``convert_bytes``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import platform
+import socket
+from typing import Any, Dict
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@contextlib.contextmanager
+def clear_environment():
+    """Temporarily empty ``os.environ``; restore on exit (reference
+    ``utils/other.py:211``).  Mutations made inside the block are discarded."""
+    backup = os.environ.copy()
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(backup)
+
+
+@contextlib.contextmanager
+def patch_environment(**kwargs):
+    """Temporarily set env vars (reference ``utils/other.py:246``); keys are
+    upper-cased, values stringified, previous values restored on exit."""
+    existing = {}
+    missing = set()
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        else:
+            missing.add(key)
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True):
+    """Unwrap a model from framework containers (reference ``utils/other.py:56``).
+
+    The torch wrappers (DDP/FSDP/compiled modules) do not exist on this stack —
+    flax modules pass through ``prepare()`` unwrapped — so this unwraps only the
+    containers that DO exist here: :class:`~accelerate_tpu.big_modeling.
+    StreamingTransformer` (returns the underlying flax Transformer) and
+    anything exposing ``.module`` (torch-style duck type).
+    """
+    from ..big_modeling import StreamingTransformer
+
+    if isinstance(model, StreamingTransformer):
+        from ..models.transformer import Transformer
+
+        return Transformer(model.config)
+    while hasattr(model, "module") and not hasattr(model, "apply"):
+        model = model.module
+    return model
+
+
+def check_os_kernel():
+    """Warn on Linux kernels < 5.5 (reference ``utils/other.py:334``: known
+    hangs in shared-memory transports on older kernels)."""
+    if platform.system() != "Linux":
+        return
+    release = platform.release()
+    try:
+        major, minor = (int(p) for p in release.split(".")[:2])
+    except ValueError:
+        return
+    if (major, minor) < (5, 5):
+        logger.warning(
+            f"Detected Linux kernel {release} < 5.5; multi-process data loading "
+            "and host collectives can hang on old kernels. Consider upgrading."
+        )
+
+
+def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool = False):
+    """Save ``obj`` on the main process only (reference ``utils/other.py:176``).
+
+    Tensor pytrees go through safetensors when ``safe_serialization``;
+    anything else is pickled.
+    """
+    from ..state import PartialState
+
+    state = PartialState()
+    should = state.is_main_process if not save_on_each_node else state.is_local_main_process
+    if not should:
+        return
+    if safe_serialization:
+        import numpy as np
+        from safetensors.numpy import save_file
+
+        from .modeling import flatten_tree
+
+        flat = {k: np.asarray(v) for k, v in flatten_tree(obj).items()}
+        save_file(flat, f)
+        return
+    import pickle
+
+    with open(f, "wb") as fh:
+        pickle.dump(obj, fh)
+
+
+def is_port_in_use(port: int) -> bool:
+    """True if localhost:port already has a listener (reference
+    ``utils/launch.py:179-185`` rendezvous pre-check)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", int(port))) == 0
+
+
+def merge_dicts(source: Dict, destination: Dict) -> Dict:
+    """Recursively merge ``source`` into ``destination`` (reference helper)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte size (reference ``utils/other.py`` convert_bytes)."""
+    for unit in ("bytes", "KB", "MB", "GB", "TB"):
+        if size < 1024:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024
+    return f"{round(size, 2)} PB"

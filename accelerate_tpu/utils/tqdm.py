@@ -1,0 +1,31 @@
+"""Main-process-only progress bars (reference ``src/accelerate/utils/tqdm.py:26``)."""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """Drop-in ``tqdm.auto.tqdm`` that renders only on the main process.
+
+    ``tqdm(iterable, main_process_only=False)`` restores per-process bars.
+    Mirrors the reference wrapper, including rejecting the old positional
+    ``main_process_only`` calling convention with a clear error.
+    """
+    if not is_tqdm_available():
+        raise ImportError(
+            "Accelerate's tqdm wrapper requires tqdm: `pip install tqdm`."
+        )
+    if args and isinstance(args[0], bool):
+        raise ValueError(
+            "Pass main_process_only as a keyword argument: "
+            "tqdm(iterable, main_process_only=False)"
+        )
+    from tqdm.auto import tqdm as _tqdm
+
+    from ..state import PartialState
+
+    disable = kwargs.pop("disable", False)
+    if main_process_only and not disable:
+        disable = not PartialState().is_main_process
+    return _tqdm(*args, disable=disable, **kwargs)

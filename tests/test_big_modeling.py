@@ -342,6 +342,34 @@ class TestStreamingExecutor:
             ex.params = {"mod": {"w": np.full((8, 8), scale, np.float32)}}
             np.testing.assert_allclose(np.asarray(ex(x)), 8.0 * scale)
 
+    def test_scan_layout_rebind_detected(self):
+        # review finding: the cached layer stack must revalidate against the
+        # params["layers"] subtree identity, not persist across rebinds
+        cfg = TransformerConfig.tiny(scan_layers=True, dtype=jnp.float32, param_dtype=jnp.float32)
+        model = Transformer(cfg)
+        ids = jnp.ones((1, 8), jnp.int32)
+        p1 = model.init(jax.random.PRNGKey(0), ids)["params"]
+        p2 = model.init(jax.random.PRNGKey(1), ids)["params"]
+        st = StreamingTransformer(cfg, p1)
+        out1 = np.asarray(st(ids))
+        st.params = p2
+        out2 = np.asarray(st(ids))
+        ref2 = np.asarray(model.apply({"params": p2}, ids))
+        np.testing.assert_allclose(out2, ref2, rtol=1e-5, atol=1e-5)
+        assert not np.allclose(out1, out2)
+
+    def test_rebind_prunes_buffer_registry(self):
+        from accelerate_tpu import StreamingExecutor
+
+        plan = [("mod", lambda p, x: x @ p["w"])]
+        ex = StreamingExecutor(plan, params={"mod": {"w": np.ones((8, 8), np.float32)}})
+        x = jnp.ones((2, 8))
+        for scale in (2.0, 3.0, 4.0):
+            ex.params = {"mod": {"w": np.full((8, 8), scale, np.float32)}}
+            ex(x)
+        # superseded snapshots must be evicted, not accumulated
+        assert len(ex._buffer_registry) == 1
+
     def test_tied_module_packs_once(self):
         from accelerate_tpu import StreamingExecutor
 

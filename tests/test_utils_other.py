@@ -1,0 +1,193 @@
+"""Aux utils (reference tests/test_utils.py: patch_environment, clear_environment,
+extract_model_from_parallel, save, convert_bytes; utils/tqdm.py; menu TUI;
+.bin checkpoint fallback per utils/modeling.py:1608-1830)."""
+
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    check_os_kernel,
+    clear_environment,
+    convert_bytes,
+    extract_model_from_parallel,
+    is_port_in_use,
+    merge_dicts,
+    patch_environment,
+    save,
+    tqdm,
+)
+
+
+class TestEnvironmentPatching:
+    def test_patch_environment_sets_and_restores(self):
+        os.environ["ATPU_EXISTING"] = "old"
+        try:
+            with patch_environment(atpu_existing="new", atpu_fresh=123):
+                assert os.environ["ATPU_EXISTING"] == "new"
+                assert os.environ["ATPU_FRESH"] == "123"
+            assert os.environ["ATPU_EXISTING"] == "old"
+            assert "ATPU_FRESH" not in os.environ
+        finally:
+            os.environ.pop("ATPU_EXISTING", None)
+
+    def test_patch_environment_restores_on_error(self):
+        with pytest.raises(RuntimeError):
+            with patch_environment(atpu_err="x"):
+                raise RuntimeError
+        assert "ATPU_ERR" not in os.environ
+
+    def test_clear_environment(self):
+        os.environ["ATPU_KEEP"] = "1"
+        try:
+            with clear_environment():
+                assert "ATPU_KEEP" not in os.environ
+                os.environ["ATPU_INSIDE"] = "x"  # discarded on exit
+            assert os.environ["ATPU_KEEP"] == "1"
+            assert "ATPU_INSIDE" not in os.environ
+        finally:
+            os.environ.pop("ATPU_KEEP", None)
+
+
+class TestMiscUtils:
+    def test_convert_bytes(self):
+        assert convert_bytes(1024) == "1.0 KB"
+        assert convert_bytes(3 * 1024**3) == "3.0 GB"
+
+    def test_merge_dicts(self):
+        dst = {"a": {"b": 1}, "c": 2}
+        merge_dicts({"a": {"d": 3}, "c": 4}, dst)
+        assert dst == {"a": {"b": 1, "d": 3}, "c": 4}
+
+    def test_is_port_in_use(self):
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            s.listen(1)
+            port = s.getsockname()[1]
+            assert is_port_in_use(port)
+        assert not is_port_in_use(port)
+
+    def test_check_os_kernel_no_raise(self):
+        check_os_kernel()
+
+    def test_extract_model_from_streaming(self):
+        from accelerate_tpu import StreamingTransformer
+        from accelerate_tpu.models.transformer import Transformer, TransformerConfig
+
+        cfg = TransformerConfig.tiny()
+        model = Transformer(cfg)
+        ids = jnp.ones((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        streamer = StreamingTransformer(cfg, params)
+        unwrapped = extract_model_from_parallel(streamer)
+        assert isinstance(unwrapped, Transformer)
+        assert unwrapped.config == cfg
+
+    def test_extract_model_passthrough(self):
+        from accelerate_tpu.models.transformer import Transformer, TransformerConfig
+
+        model = Transformer(TransformerConfig.tiny())
+        assert extract_model_from_parallel(model) is model
+
+    def test_save_main_process_only(self, tmp_path):
+        from safetensors.numpy import load_file
+
+        path = str(tmp_path / "obj.safetensors")
+        save({"w": np.ones((2, 2), np.float32)}, path, safe_serialization=True)
+        assert load_file(path)["w"].shape == (2, 2)
+        path2 = str(tmp_path / "obj.pkl")
+        save({"x": 1}, path2)
+        import pickle
+
+        assert pickle.load(open(path2, "rb")) == {"x": 1}
+
+
+class TestTqdmWrapper:
+    def test_main_process_bar(self):
+        bar = tqdm(range(3), disable=False)
+        assert list(bar) == [0, 1, 2]
+
+    def test_positional_bool_rejected(self):
+        with pytest.raises(ValueError, match="keyword"):
+            tqdm(True, range(3))
+
+
+class TestMenu:
+    def test_plain_fallback_default(self, monkeypatch):
+        from accelerate_tpu.commands.menu import BulletMenu
+
+        monkeypatch.setattr("sys.stdin", io.StringIO("\n"))
+        assert BulletMenu("pick", ["a", "b"]).run(1) == 1
+
+    def test_plain_fallback_numbered_and_named(self, monkeypatch):
+        from accelerate_tpu.commands.menu import BulletMenu, select
+
+        monkeypatch.setattr("sys.stdin", io.StringIO("0\n"))
+        assert BulletMenu("pick", ["a", "b"]).run(1) == 0
+        monkeypatch.setattr("sys.stdin", io.StringIO("b\n"))
+        assert select("pick", ["a", "b"], default="a") == "b"
+
+    def test_plain_fallback_invalid_uses_default(self, monkeypatch):
+        from accelerate_tpu.commands.menu import BulletMenu
+
+        monkeypatch.setattr("sys.stdin", io.StringIO("zzz\n"))
+        assert BulletMenu("pick", ["a", "b"]).run(1) == 1
+
+
+class TestBinCheckpointFallback:
+    def _save_bin(self, tmp_path, sharded=False):
+        import torch
+
+        sd = {
+            "embed.weight": torch.arange(12, dtype=torch.float32).reshape(3, 4),
+            "head.weight": torch.ones((4, 2), dtype=torch.bfloat16),
+        }
+        if not sharded:
+            torch.save(sd, str(tmp_path / "pytorch_model.bin"))
+        else:
+            import json
+
+            torch.save({"embed.weight": sd["embed.weight"]}, str(tmp_path / "shard-1.bin"))
+            torch.save({"head.weight": sd["head.weight"]}, str(tmp_path / "shard-2.bin"))
+            index = {"weight_map": {"embed.weight": "shard-1.bin", "head.weight": "shard-2.bin"}}
+            (tmp_path / "pytorch_model.bin.index.json").write_text(json.dumps(index))
+        return sd
+
+    def test_bin_shapes_and_tensors(self, tmp_path):
+        from accelerate_tpu.big_modeling import _checkpoint_files, _read_tensors, checkpoint_shapes
+
+        self._save_bin(tmp_path)
+        files = _checkpoint_files(str(tmp_path))
+        assert set(files) == {"embed.weight", "head.weight"}
+        shapes = checkpoint_shapes(str(tmp_path), files=files)
+        assert shapes["embed.weight"].shape == (3, 4)
+        assert shapes["head.weight"].dtype == jnp.bfloat16
+        tensors = _read_tensors(files, list(files))
+        np.testing.assert_allclose(tensors["embed.weight"].reshape(-1), np.arange(12))
+        assert tensors["head.weight"].dtype == jnp.bfloat16
+
+    def test_sharded_bin_index(self, tmp_path):
+        from accelerate_tpu.big_modeling import _checkpoint_files, _read_tensors
+
+        self._save_bin(tmp_path, sharded=True)
+        files = _checkpoint_files(str(tmp_path))
+        assert files["embed.weight"].endswith("shard-1.bin")
+        tensors = _read_tensors(files, list(files))
+        assert tensors["head.weight"].shape == (4, 2)
+
+    def test_load_checkpoint_and_dispatch_bin(self, tmp_path):
+        from accelerate_tpu import load_checkpoint_and_dispatch
+
+        self._save_bin(tmp_path)
+        params, dm, loader = load_checkpoint_and_dispatch(
+            None, str(tmp_path), device_map="sharded"
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["embed"]["weight"]).reshape(-1), np.arange(12)
+        )
