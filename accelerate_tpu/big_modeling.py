@@ -527,9 +527,12 @@ class StreamingExecutor:
         if entry is not None and all(a is b for a, b in zip(entry[0], group_leaves)):
             return entry[1]
         arrs = [np.asarray(x).reshape(-1) for x in group_leaves]
-        # explicit copy even for a single leaf: every packed buffer is a
-        # snapshot, never a live view of caller memory
-        buffer = np.concatenate(arrs) if len(arrs) > 1 else arrs[0].copy()
+        # pack_buffers = multithreaded native gather when libatpu_runtime is
+        # built, np.concatenate otherwise; either way the result is a snapshot
+        # copy, never a live view of caller memory
+        from .utils import _native
+
+        buffer = _native.pack_buffers(arrs)
         self._buffer_registry[gkey] = (tuple(group_leaves), buffer)
         return buffer
 

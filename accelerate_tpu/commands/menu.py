@@ -27,18 +27,25 @@ class BulletMenu:
         import termios
         import tty
 
+        def pending() -> bool:
+            return bool(select.select([sys.stdin], [], [], 0.05)[0])
+
         fd = sys.stdin.fileno()
         old = termios.tcgetattr(fd)
         try:
             tty.setraw(fd)
             ch = sys.stdin.read(1)
             if ch == "\x1b":
-                # bare Escape vs arrow sequence: only read the continuation if
-                # bytes are already pending, else a lone Esc would block here
-                if not select.select([sys.stdin], [], [], 0.05)[0]:
+                # Disambiguate byte-by-byte so neither a bare Esc nor Alt+key
+                # (ESC + one byte) can block on a read of missing bytes.
+                if not pending():
                     return "esc"
-                seq = sys.stdin.read(2)
-                return {"[A": "up", "[B": "down"}.get(seq, "esc")
+                b1 = sys.stdin.read(1)
+                if b1 != "[" or not pending():
+                    return "other"  # Alt+key chords etc: ignore, don't abort
+                b2 = sys.stdin.read(1)
+                # unknown CSI sequences (left/right/home/...) are ignored
+                return {"A": "up", "B": "down"}.get(b2, "other")
             return ch
         finally:
             termios.tcsetattr(fd, termios.TCSADRAIN, old)
@@ -70,8 +77,9 @@ class BulletMenu:
                 selected = int(key)
             elif key in ("\r", "\n"):
                 return selected
-            elif key in ("\x03", "esc"):  # ctrl-c
+            elif key in ("\x03", "esc"):  # ctrl-c / bare Escape
                 raise KeyboardInterrupt
+            # "other" (unknown sequences, stray keys) falls through to redraw
             self._draw(selected, first=False)
 
     def _run_plain(self, default: int) -> int:

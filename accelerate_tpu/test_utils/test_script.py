@@ -110,13 +110,15 @@ def distributed_vs_single_check(accelerator):
     from accelerate_tpu import SimpleDataLoader
     from accelerate_tpu.test_utils.training import RegressionModel, regression_dataset
 
-    data = regression_dataset(64)
     model = RegressionModel()
 
     # ground truth: hand-rolled single-device loop over the same GLOBAL batches
     # (batch_size is per-process — reference split_batches=False semantics — so
-    # the global batch is 16 * num_processes)
+    # the global batch is 16 * num_processes).  Size the dataset as a multiple
+    # of the global batch so any process count (including odd ones) divides
+    # evenly and the two loops see identical batches.
     gb = 16 * max(accelerator.num_processes, 1)
+    data = regression_dataset(4 * gb)
     X = jnp.asarray(np.stack([d["x"] for d in data]))
     Y = jnp.asarray(np.stack([d["y"] for d in data]))
     tx = optax.sgd(0.05)
@@ -133,7 +135,7 @@ def distributed_vs_single_check(accelerator):
         return optax.apply_updates(params, updates), opt_state, loss
 
     for epoch in range(2):
-        for start in range(0, 64, gb):
+        for start in range(0, len(data), gb):
             params, opt_state, loss = ref_step(
                 params, opt_state, X[start : start + gb], Y[start : start + gb]
             )

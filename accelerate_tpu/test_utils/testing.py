@@ -16,11 +16,41 @@ import tempfile
 import unittest
 from typing import List, Optional
 
+import functools
+
 import jax
 
 
-def _skip_unless(condition: bool, reason: str):
-    return unittest.skipUnless(condition, reason)
+def _skip_unless(predicate, reason: str):
+    """Lazy skip decorator: ``predicate`` is evaluated at TEST time, not at
+    decoration/import time.  This matters because most predicates touch
+    ``jax.devices()``, which initializes the XLA backend — under
+    ``accelerate-tpu launch`` that must not happen before
+    ``jax.distributed.initialize`` (see the matching guard in state.py).
+    Works on test functions/methods and on unittest classes (via setUp).
+    """
+
+    def decorator(test_case):
+        if isinstance(test_case, type):
+            orig_setup = test_case.setUp
+
+            def setUp(self):
+                if not predicate():
+                    raise unittest.SkipTest(reason)
+                orig_setup(self)
+
+            test_case.setUp = setUp
+            return test_case
+
+        @functools.wraps(test_case)
+        def wrapper(*args, **kwargs):
+            if not predicate():
+                raise unittest.SkipTest(reason)
+            return test_case(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
 
 
 def device_platform() -> str:
@@ -35,45 +65,48 @@ def is_tpu_available() -> bool:
 
 def require_cpu(test_case):
     """Run only when no accelerator is active (reference ``require_cpu``)."""
-    return _skip_unless(device_platform() == "cpu", "test requires a CPU-only runtime")(test_case)
+    return _skip_unless(lambda: device_platform() == "cpu", "test requires a CPU-only runtime")(test_case)
 
 
 def require_non_cpu(test_case):
-    return _skip_unless(device_platform() != "cpu", "test requires an accelerator")(test_case)
+    return _skip_unless(lambda: device_platform() != "cpu", "test requires an accelerator")(test_case)
 
 
 def require_tpu(test_case):
-    return _skip_unless(is_tpu_available(), "test requires a TPU")(test_case)
+    return _skip_unless(is_tpu_available, "test requires a TPU")(test_case)
 
 
 def require_multi_device(test_case):
     """Needs >= 2 devices (real chips or the forced host-platform mesh)."""
-    return _skip_unless(len(jax.devices()) > 1, "test requires multiple devices")(test_case)
+    return _skip_unless(lambda: len(jax.devices()) > 1, "test requires multiple devices")(test_case)
 
 
 def require_single_device(test_case):
-    return _skip_unless(len(jax.devices()) == 1, "test requires exactly one device")(test_case)
+    return _skip_unless(lambda: len(jax.devices()) == 1, "test requires exactly one device")(test_case)
 
 
 def require_pallas(test_case):
     """Pallas TPU kernels compile on TPU backends only (interpret mode aside)."""
-    return _skip_unless(is_tpu_available(), "test requires pallas TPU support")(test_case)
+    return _skip_unless(is_tpu_available, "test requires pallas TPU support")(test_case)
 
 
 def require_fork(test_case):
     """Multi-process CPU tests need working subprocess spawn (absent on some
     sandboxes/WASM)."""
-    ok = hasattr(os, "fork") or sys.platform == "win32"
-    return _skip_unless(ok, "test requires process spawning")(test_case)
+    return _skip_unless(
+        lambda: hasattr(os, "fork") or sys.platform == "win32",
+        "test requires process spawning",
+    )(test_case)
 
 
 def require_tracker(name: str):
     """Skip unless the given experiment tracker's package is importable
     (reference per-tracker ``require_wandb``/``require_comet_ml``/...)."""
-    from ..utils import imports
+    def available() -> bool:
+        from ..utils import imports
 
-    probe = getattr(imports, f"is_{name}_available", None)
-    available = probe() if probe is not None else imports._is_package_available(name)
+        probe = getattr(imports, f"is_{name}_available", None)
+        return probe() if probe is not None else imports._is_package_available(name)
 
     def decorator(test_case):
         return _skip_unless(available, f"test requires {name}")(test_case)
@@ -87,7 +120,7 @@ def require_env_true(var: str):
 
     def decorator(test_case):
         return _skip_unless(
-            os.environ.get(var, "").lower() in ("1", "true", "yes"),
+            lambda: os.environ.get(var, "").lower() in ("1", "true", "yes"),
             f"test requires {var}=1",
         )(test_case)
 

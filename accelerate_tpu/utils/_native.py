@@ -1,8 +1,13 @@
-"""Loader for the optional C++ runtime extension (built from ``native/``).
+"""ctypes bindings for the optional C++ host runtime (``native/atpu_runtime.cpp``).
 
-The extension provides an mmap-backed safetensors reader and a prefetching batch
-pipeline (see ``native/README.md``).  Pure-Python fallbacks exist for every entry
-point, so the framework works without a compiler.
+Entry points (each with a pure-Python fallback, so no compiler is required):
+
+* :func:`pack_buffers` — multithreaded gather of numpy leaves into one
+  contiguous buffer (StreamingExecutor packed-transfer hot path; falls back
+  to ``np.concatenate``).
+* :func:`read_blocks` — parallel ``pread`` of file extents (falls back to
+  seek+readinto).
+* :func:`build` — compile the library in-tree with ``make`` (g++).
 """
 
 from __future__ import annotations
@@ -10,16 +15,23 @@ from __future__ import annotations
 import ctypes
 import glob
 import os
-from typing import Optional
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _find_library() -> Optional[str]:
+def _native_dir() -> str:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    candidates = glob.glob(os.path.join(root, "native", "libatpu_runtime*.so")) + glob.glob(
-        os.path.join(root, "native", "build", "libatpu_runtime*.so")
+    return os.path.join(root, "native")
+
+
+def _find_library() -> Optional[str]:
+    candidates = glob.glob(os.path.join(_native_dir(), "libatpu_runtime*.so")) + glob.glob(
+        os.path.join(_native_dir(), "build", "libatpu_runtime*.so")
     )
     return candidates[0] if candidates else None
 
@@ -32,11 +44,115 @@ def get_library() -> Optional[ctypes.CDLL]:
     path = _find_library()
     if path is not None:
         try:
-            _LIB = ctypes.CDLL(path)
-        except OSError:
+            lib = ctypes.CDLL(path)
+            lib.atpu_version.restype = ctypes.c_int
+            lib.atpu_pack.restype = ctypes.c_int
+            lib.atpu_pack.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_int,
+            ]
+            lib.atpu_read_blocks.restype = ctypes.c_int
+            lib.atpu_read_blocks.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
+            _LIB = lib
+        except (OSError, AttributeError):
             _LIB = None
     return _LIB
 
 
 def is_available() -> bool:
     return get_library() is not None
+
+
+def build(verbose: bool = False) -> bool:
+    """Compile ``libatpu_runtime.so`` in-tree; returns availability."""
+    global _TRIED, _LIB
+    result = subprocess.run(
+        ["make", "-C", _native_dir()],
+        capture_output=not verbose,
+        text=True,
+    )
+    if result.returncode != 0:
+        if not verbose:
+            print(result.stdout or "", result.stderr or "")
+        return False
+    _TRIED = False
+    _LIB = None
+    return is_available()
+
+
+# ------------------------------------------------------------------ pack
+def pack_buffers(arrays: Sequence[np.ndarray], n_threads: int = 0) -> np.ndarray:
+    """Gather 1-D same-dtype arrays into one contiguous buffer.
+
+    Native path: N-way parallel memcpy over the total byte range.  Fallback:
+    ``np.concatenate`` (single leaf still snapshots via ``.copy()``).
+    """
+    arrays = [np.ascontiguousarray(a).reshape(-1) for a in arrays]
+    if not arrays:
+        raise ValueError("pack_buffers needs at least one array")
+    dtype = arrays[0].dtype
+    if any(a.dtype != dtype for a in arrays):
+        raise ValueError("pack_buffers requires a single dtype per call")
+
+    def fallback():
+        return np.concatenate(arrays) if len(arrays) > 1 else arrays[0].copy()
+
+    lib = get_library()
+    if lib is None:
+        return fallback()
+    total = sum(a.size for a in arrays)
+    out = np.empty(total, dtype=dtype)
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_uint64 * n)(*[a.nbytes for a in arrays])
+    rc = lib.atpu_pack(srcs, sizes, n, out.ctypes.data_as(ctypes.c_void_p), n_threads)
+    if rc != 0:
+        return fallback()
+    return out
+
+
+# ------------------------------------------------------------------ read
+def read_blocks(
+    path: str,
+    offsets: Sequence[int],
+    sizes: Sequence[int],
+    n_threads: int = 0,
+) -> List[np.ndarray]:
+    """Read N byte extents of ``path`` into fresh uint8 buffers (parallel
+    pread natively; sequential seek+readinto as fallback)."""
+    outs = [np.empty(int(s), dtype=np.uint8) for s in sizes]
+    lib = get_library()
+    if lib is None:
+        with open(path, "rb") as f:
+            for off, size, buf in zip(offsets, sizes, outs):
+                f.seek(int(off))
+                view = memoryview(buf)
+                done = 0
+                while done < int(size):
+                    got = f.readinto(view[done:])
+                    if not got:  # EOF before the extent was satisfied
+                        raise IOError(
+                            f"short read: {path!r} offset {off} wanted {size} got {done}"
+                        )
+                    done += got
+        return outs
+    n = len(outs)
+    if n == 0:
+        return outs
+    offs = (ctypes.c_uint64 * n)(*[int(o) for o in offsets])
+    szs = (ctypes.c_uint64 * n)(*[int(s) for s in sizes])
+    dsts = (ctypes.c_void_p * n)(*[b.ctypes.data for b in outs])
+    rc = lib.atpu_read_blocks(path.encode(), offs, szs, dsts, n, n_threads)
+    if rc != 0:
+        raise IOError(f"atpu_read_blocks({path!r}) failed with rc={rc}")
+    return outs

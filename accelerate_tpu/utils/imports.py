@@ -107,8 +107,12 @@ def is_pallas_available() -> bool:
         return False
 
 
-def is_native_dataloader_available() -> bool:
-    """True when the C++ data-loader extension has been built (see native/)."""
+def is_native_runtime_available() -> bool:
+    """True when the C++ host-runtime extension is built (accelerate_tpu/native/)."""
     from . import _native
 
     return _native.is_available()
+
+
+# backwards-compatible alias (pre-0.1 name)
+is_native_dataloader_available = is_native_runtime_available

@@ -1,0 +1,100 @@
+"""Native host-runtime extension (accelerate_tpu/native/): build, bindings,
+fallbacks, and the StreamingExecutor integration."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import _native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_library():
+    """Build the extension for this module's tests (g++ is in the image);
+    restore loader state afterwards."""
+    if not _native.is_available():
+        if shutil.which("make") is None or shutil.which("g++") is None:
+            pytest.skip("no native toolchain")
+        assert _native.build(), "native build failed"
+    yield
+
+
+class TestPack:
+    def test_matches_concatenate(self):
+        arrs = [
+            np.random.default_rng(i).standard_normal(10_000 + i).astype(np.float32)
+            for i in range(7)
+        ]
+        np.testing.assert_array_equal(_native.pack_buffers(arrs), np.concatenate(arrs))
+
+    def test_single_leaf_is_snapshot(self):
+        a = np.ones(100, np.float32)
+        out = _native.pack_buffers([a])
+        a[:] = 0
+        assert out.sum() == 100  # copy, not a view
+
+    def test_large_parallel_path(self):
+        # > 8MB triggers the threaded branch
+        arrs = [np.full(3_000_000, float(i), np.float32) for i in range(4)]
+        out = _native.pack_buffers(arrs)
+        np.testing.assert_array_equal(out, np.concatenate(arrs))
+
+    def test_int8_dtype(self):
+        arrs = [np.random.default_rng(i).integers(-100, 100, 5000).astype(np.int8) for i in range(3)]
+        np.testing.assert_array_equal(_native.pack_buffers(arrs), np.concatenate(arrs))
+
+    def test_mixed_dtype_rejected(self):
+        with pytest.raises(ValueError, match="single dtype"):
+            _native.pack_buffers([np.ones(4, np.float32), np.ones(4, np.int8)])
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError, match="at least one"):
+            _native.pack_buffers([])
+
+
+class TestReadBlocks:
+    def test_extents(self, tmp_path):
+        data = np.random.default_rng(0).integers(0, 255, 1 << 18).astype(np.uint8)
+        path = str(tmp_path / "blob.bin")
+        data.tofile(path)
+        offsets, sizes = [0, 1000, 200_000], [128, 4096, 62_144]
+        blocks = _native.read_blocks(path, offsets, sizes)
+        for off, size, block in zip(offsets, sizes, blocks):
+            np.testing.assert_array_equal(block, data[off : off + size])
+
+    def test_missing_file_raises(self):
+        with pytest.raises((IOError, OSError)):
+            _native.read_blocks("/nonexistent/path.bin", [0], [10])
+
+
+class TestFallback:
+    def test_python_fallback_pack_and_read(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(_native, "get_library", lambda: None)
+        arrs = [np.arange(10, dtype=np.float32), np.arange(5, dtype=np.float32)]
+        np.testing.assert_array_equal(_native.pack_buffers(arrs), np.concatenate(arrs))
+        data = np.arange(256, dtype=np.uint8)
+        path = str(tmp_path / "b.bin")
+        data.tofile(path)
+        (block,) = _native.read_blocks(path, [16], [32])
+        np.testing.assert_array_equal(block, data[16:48])
+
+
+class TestStreamingIntegration:
+    def test_streaming_uses_native_pack(self):
+        import jax.numpy as jnp
+
+        from accelerate_tpu import StreamingExecutor
+
+        assert _native.is_available()
+        params = {"mod": {"w": np.ones((64, 64), np.float32), "b": np.zeros(64, np.float32)}}
+        ex = StreamingExecutor([("mod", lambda p, x: x @ p["w"] + p["b"])], params=params)
+        out = ex(jnp.ones((2, 64)))
+        np.testing.assert_allclose(np.asarray(out), 64.0)
+
+    def test_probe(self):
+        from accelerate_tpu.utils.imports import is_native_runtime_available
+
+        assert is_native_runtime_available()
