@@ -358,6 +358,13 @@ class Accelerator:
         return self.state.use_distributed
 
     @property
+    def _use_loss_scaling(self) -> bool:
+        """fp16 dynamic loss scaling, honoring GradScalerKwargs(enabled=False)."""
+        return self.policy.use_loss_scaling and (
+            self.scaler_handler.enabled if self.scaler_handler else True
+        )
+
+    @property
     def sync_gradients(self) -> bool:
         return self.gradient_state.sync_gradients
 
@@ -555,8 +562,17 @@ class Accelerator:
                 params=p,
                 tx=tx,
                 gradient_accumulation_steps=self.gradient_accumulation_steps,
-                use_loss_scaling=self.policy.use_loss_scaling,
+                use_loss_scaling=self._use_loss_scaling,
                 init_loss_scale=(self.scaler_handler.init_scale if self.scaler_handler else 2.0**16),
+                loss_scale_kwargs=(
+                    {
+                        "growth_factor": self.scaler_handler.growth_factor,
+                        "backoff_factor": self.scaler_handler.backoff_factor,
+                        "growth_interval": self.scaler_handler.growth_interval,
+                    }
+                    if self.scaler_handler
+                    else None
+                ),
                 rng=rng,
                 grad_accum_dtype=grad_accum_dtype,
             )
@@ -770,7 +786,7 @@ class Accelerator:
         wrapped_loss = self._maybe_remat(wrapped_loss)
         accum = self.gradient_accumulation_steps
         policy = self.policy
-        fp16 = policy.use_loss_scaling
+        fp16 = self._use_loss_scaling
         # Gradient carry dtype (the DDP fp16/bf16 compression-hook analog):
         # grads are cast to this dtype right after the backward pass, halving
         # the accumulation buffer and any cross-step traffic under bf16.  Note

@@ -88,6 +88,7 @@ class TrainState(struct.PyTreeNode):
         gradient_accumulation_steps: int = 1,
         use_loss_scaling: bool = False,
         init_loss_scale: float = 2.0**16,
+        loss_scale_kwargs: Optional[dict] = None,
         rng: Optional[jax.Array] = None,
         grad_accum_dtype: Optional[Any] = None,
     ) -> "TrainState":
@@ -105,7 +106,11 @@ class TrainState(struct.PyTreeNode):
             params=params,
             opt_state=opt_state,
             grad_accum=grad_accum,
-            loss_scale=DynamicLossScale.create(init_loss_scale) if use_loss_scaling else None,
+            loss_scale=(
+                DynamicLossScale.create(init_loss_scale, **(loss_scale_kwargs or {}))
+                if use_loss_scaling
+                else None
+            ),
             rng=rng,
             apply_fn=apply_fn,
             tx=tx,

@@ -249,6 +249,34 @@ class TestZeroPlugin:
         assert "fsdp" in str(state.params["w"].sharding.spec)
 
 
+class TestGradScalerKwargs:
+    def test_recipe_flows_into_loss_scale(self):
+        from accelerate_tpu import GradScalerKwargs
+
+        acc = Accelerator(
+            mixed_precision="fp16",
+            kwargs_handlers=[
+                GradScalerKwargs(init_scale=1024.0, growth_factor=4.0,
+                                 backoff_factor=0.25, growth_interval=10)
+            ],
+        )
+        state = acc.create_train_state(params={"w": jnp.ones((4,))}, tx=optax.sgd(0.1))
+        assert float(state.loss_scale.scale) == 1024.0
+        assert state.loss_scale.growth_factor == 4.0
+        assert state.loss_scale.backoff_factor == 0.25
+        assert state.loss_scale.growth_interval == 10
+
+    def test_disabled_scaler(self):
+        from accelerate_tpu import GradScalerKwargs
+
+        acc = Accelerator(mixed_precision="fp16", kwargs_handlers=[GradScalerKwargs(enabled=False)])
+        state = acc.create_train_state(params={"w": jnp.ones((4,))}, tx=optax.sgd(0.1))
+        assert state.loss_scale is None
+        step = acc.compile_train_step(lambda p, b: jnp.mean((b["x"] * p["w"]) ** 2))
+        state, m = step(state, {"x": jnp.ones((2, 4))})
+        assert np.isfinite(float(m["loss"]))
+
+
 class TestOptimizerStateDict:
     """Reference contract: save/load via the optimizer wrapper (optimizer.py:38-214)."""
 

@@ -93,3 +93,22 @@ def test_feature_early_stopping():
 def test_feature_fp8():
     out = run_example("by_feature/fp8.py", "--steps", "15")
     assert "fp8 training" in out
+
+
+def test_feature_fsdp():
+    out = run_example("by_feature/fsdp.py", "--zero_stage", "3", "--steps", "10")
+    # ZeRO-3 must actually shard the params (not just name an fsdp mesh axis)
+    spec_line = next(line for line in out.splitlines() if "param spec" in line)
+    assert "fsdp" in spec_line, spec_line
+
+
+def test_feature_big_model_inference():
+    out = run_example("by_feature/big_model_inference.py")
+    assert "pooled-HBM sharded" in out
+    out = run_example("by_feature/big_model_inference.py", "--stream")
+    assert "host-streamed" in out
+
+
+def test_feature_profiler(tmp_path):
+    out = run_example("by_feature/profiler.py", "--project_dir", str(tmp_path))
+    assert "profile captured" in out
