@@ -447,10 +447,10 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 128,
+    block_k: int = 1024,
     block_q_bwd: int = 128,
-    block_k_bwd: int = 128,
+    block_k_bwd: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over BSHD tensors ``[batch, seq, heads, head_dim]``.
@@ -458,6 +458,15 @@ def flash_attention(
     GQA is supported (k/v may have fewer heads, dividing q heads).
     ``segment_ids`` is ``[batch, seq]`` int32; tokens attend only within equal
     ids (packed-sequence masking), composed with the causal mask.
+
+    Block defaults come from a v5e sweep at S=4096, H=12, D=64 (bf16, causal):
+    narrow-q/wide-k wins — fwd (128, 1024) runs 28.9 ms vs XLA's 33.3 (and
+    (128, 2048) hits 22.7 where VMEM allows); square 256x256 was 2x slower
+    than XLA.  The split backward (dq + dkv passes, each recomputing scores)
+    measures 74 ms vs XLA's 52 at its best (128, 1024) — so for TRAINING at
+    moderate sequence lengths XLA's fused attention remains the better
+    default (``attention_impl="xla"``), while this kernel wins forward-only
+    (inference/serving) and is the substrate ring attention composes with.
     """
     if interpret is None:
         interpret = _default_interpret()

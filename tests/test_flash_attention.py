@@ -112,3 +112,44 @@ def test_block_size_validation():
     q = jnp.zeros((1, 100, 2, 64), jnp.float32)
     with pytest.raises(ValueError, match="multiple of 128"):
         flash_attention(q, q, q, block_q=64, block_k=64)
+
+
+class TestDefaultWideKBlocks:
+    """The SHIPPED defaults (block_q=128, block_k=1024 fwd / 512 bwd) exercise
+    the wide-k tiling (repeats_k > 1) and asymmetric causal skip — paths the
+    S=256 tests above clamp away via _pick_block."""
+
+    def _long_qkv(self):
+        rng = np.random.default_rng(3)
+        S_long = 2048
+        mk = lambda h: jnp.asarray(rng.normal(size=(1, S_long, 2, D)), jnp.float32)
+        return mk(2), mk(1), mk(1)  # GQA: 2 q heads over 1 kv head
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_default_blocks(self, causal):
+        q, k, v = self._long_qkv()
+        out = flash_attention(q, k, v, causal=causal)  # defaults: 128x1024
+        ref = _reference_attention(q, k, v, causal=causal, scale=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_backward_default_blocks(self):
+        q, k, v = self._long_qkv()
+        g_flash = jax.grad(
+            lambda *a: (flash_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        g_ref = jax.grad(
+            lambda *a: (_reference_attention(*a, causal=True, scale=None) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            scale = max(float(jnp.abs(b).max()), 1.0)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5 * scale)
+
+    def test_segments_default_blocks(self):
+        q, k, v = self._long_qkv()
+        seg = jnp.concatenate(
+            [jnp.zeros((1, 1024), jnp.int32), jnp.ones((1, 1024), jnp.int32)], axis=1
+        )
+        out = flash_attention(q, k, v, causal=True, segment_ids=seg)
+        ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
