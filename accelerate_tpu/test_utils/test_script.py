@@ -67,6 +67,35 @@ def dl_preparation_check(accelerator):
     print(f"[{accelerator.process_index}] dataloader preparation: OK")
 
 
+def dispatcher_check(accelerator):
+    """DataLoaderDispatcher across real process boundaries: rank 0 reads, the
+    batch structure + payload broadcast to all ranks, each slices its shard —
+    the multihost broadcast path (reference data_loader.py:618-736)."""
+    from accelerate_tpu import SimpleDataLoader
+    from accelerate_tpu.data_loader import DataLoaderDispatcher, prepare_data_loader
+
+    n = max(accelerator.num_processes, 1)
+    data = [{"x": np.array([float(i)], dtype=np.float32)} for i in range(8 * n)]
+    dl = prepare_data_loader(
+        SimpleDataLoader(data, batch_size=4 * n),
+        device=accelerator.device,
+        dispatch_batches=True,
+        mesh=accelerator.mesh,
+    )
+    assert isinstance(dl, DataLoaderDispatcher), type(dl)
+    seen = []
+    for batch in dl:
+        local = host_value(batch["x"]).reshape(-1)
+        # gather the shards: together they must reconstruct the global batch
+        gathered = np.asarray(accelerator.gather(batch["x"])).reshape(-1)
+        # each rank's shard must be EXACTLY 1/n of the observed global batch
+        assert local.shape[0] == gathered.size // n, (local.shape, gathered.size, n)
+        seen.extend(gathered.tolist())
+    # no set(): duplicated samples from overlapping slices must fail, not mask
+    assert sorted(seen) == [float(i) for i in range(8 * n)], sorted(seen)[:10]
+    print(f"[{accelerator.process_index}] dispatcher broadcast+slice: OK")
+
+
 def training_check(accelerator):
     """Distributed training must match the closed-form least-squares fit."""
     import jax.numpy as jnp
@@ -169,6 +198,7 @@ def main():
     process_execution_check(accelerator)
     collectives_check(accelerator)
     dl_preparation_check(accelerator)
+    dispatcher_check(accelerator)
     training_check(accelerator)
     distributed_vs_single_check(accelerator)
     accelerator.print("All self-tests passed.")
