@@ -50,6 +50,11 @@ def launch_command_parser(subparsers=None):
     )
     hw.add_argument("--num_cpu_devices", type=int, default=None,
                     help="CPU debug mode: virtual devices per process (xla_force_host_platform_device_count).")
+    hw.add_argument("--max_restarts", type=int, default=0,
+                    help="Relaunch the script/worker gang up to N times after failures "
+                         "(torchelastic max_restarts analog; supervision is first-party).")
+    hw.add_argument("--monitor_interval", type=float, default=1.0,
+                    help="Seconds between worker liveness polls in multi-process mode.")
     # training config
     tr = parser.add_argument_group("Training")
     tr.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16"])
@@ -224,42 +229,103 @@ def _apply_cpu_device_count(env: Dict[str, str], num_cpu_devices: Optional[int])
         env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={num_cpu_devices}".strip()
 
 
+def _supervise(run_once, max_restarts: int, what: str) -> int:
+    """Shared restart supervision: rerun ``run_once() -> rc`` after non-zero
+    exits, up to ``max_restarts`` times (torchelastic ``max_restarts`` analog;
+    supervision is first-party here)."""
+    restarts = 0
+    while True:
+        rc = run_once()
+        if rc == 0 or restarts >= max_restarts:
+            return rc
+        restarts += 1
+        print(
+            f"[accelerate-tpu launch] {what} failed rc={rc}; "
+            f"restart {restarts}/{max_restarts}",
+            file=sys.stderr,
+        )
+
+
 def simple_launcher(args, config: ClusterConfig) -> int:
     """One process on this host (reference ``simple_launcher``/``tpu_launcher``
     collapsed: a single JAX process drives all local chips)."""
+    if args.max_restarts and config.num_machines > 1:
+        # an uncoordinated single-host restart cannot re-rendezvous: the other
+        # hosts still hold the old jax.distributed session and never re-enter
+        # the barrier. Gang-wide restart needs the cluster scheduler.
+        raise ValueError(
+            "--max_restarts is single-host only: restarting one pod worker alone "
+            "cannot rejoin the jax.distributed rendezvous. Use your cluster "
+            "scheduler's restart policy for multi-host elasticity."
+        )
     launch_env = prepare_launch_env(config)
     if config.use_cpu:
         _apply_cpu_device_count(launch_env, args.num_cpu_devices)
     elif args.num_cpu_devices:
         raise ValueError("--num_cpu_devices only applies with --cpu.")
     env = {**os.environ, **launch_env}
-    proc = subprocess.run(_script_cmd(args), env=env)
-    return proc.returncode
+    return _supervise(
+        lambda: subprocess.run(_script_cmd(args), env=env).returncode,
+        args.max_restarts,
+        "script",
+    )
 
 
 def multi_process_cpu_launcher(args, config: ClusterConfig, num_processes: int) -> int:
     """Fork N local processes rendezvousing over localhost (reference
-    ``debug_launcher``: fork + gloo; here fork + jax.distributed on CPU)."""
-    import socket
+    ``debug_launcher``: fork + gloo; here fork + jax.distributed on CPU).
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    Elastic supervision (reference forwards to torchelastic,
+    ``launchers.py:226-239``; first-party here): workers are polled every
+    ``--monitor_interval`` seconds; when any worker dies the remaining workers
+    are torn down (a lost rank would hang the collective rendezvous forever)
+    and — up to ``--max_restarts`` times — the whole gang is relaunched on a
+    fresh coordinator port.
+    """
+    import socket
+    import time
+
     base_env = prepare_launch_env(config)
-    base_env["ACCELERATE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     base_env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
     base_env["JAX_PLATFORMS"] = "cpu"
     _apply_cpu_device_count(base_env, args.num_cpu_devices)
-    procs = []
-    for rank in range(num_processes):
-        env = {**os.environ, **base_env,
-               "ACCELERATE_PROCESS_ID": str(rank), "ACCELERATE_LOCAL_PROCESS_ID": str(rank)}
-        procs.append(subprocess.Popen(_script_cmd(args), env=env))
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    return rc
+
+    def start_gang():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = []
+        for rank in range(num_processes):
+            env = {**os.environ, **base_env,
+                   "ACCELERATE_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                   "ACCELERATE_PROCESS_ID": str(rank), "ACCELERATE_LOCAL_PROCESS_ID": str(rank)}
+            procs.append(subprocess.Popen(_script_cmd(args), env=env))
+        return procs
+
+    def run_gang() -> int:
+        procs = start_gang()
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                return next((c for c in codes if c), 0)
+            if any(c not in (None, 0) for c in codes):
+                # a rank died while others live: tear down the gang (the
+                # survivors would block in collectives forever). Escalate
+                # SIGTERM -> SIGKILL so a worker with a SIGTERM handler (or
+                # stuck in uninterruptible IO) cannot wedge the supervisor.
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
+                return next(c for c in codes if c)
+            time.sleep(args.monitor_interval)
+
+    return _supervise(run_gang, args.max_restarts, "gang")
 
 
 def launch_command(args) -> None:

@@ -60,6 +60,65 @@ class TestLauncherSelfTest(testing.TempDirTestCase):
     clear_on_setup = False  # checkpoint test needs files across one method only
 
 
+@require_fork
+class TestElasticRestarts(testing.TempDirTestCase):
+    """First-party launcher supervision (the torchelastic analog):
+    --max_restarts relaunches after failure; a dead rank tears down the gang
+    instead of hanging the survivors."""
+
+    def test_simple_restart_succeeds_second_try(self):
+        marker = os.path.join(self.tmpdir, "attempted")
+        script = os.path.join(self.tmpdir, "flaky.py")
+        with open(script, "w") as f:
+            f.write(
+                "import os, sys\n"
+                f"marker = {marker!r}\n"
+                "if not os.path.exists(marker):\n"
+                "    open(marker, 'w').write('x')\n"
+                "    sys.exit(3)\n"
+                "print('second attempt ok')\n"
+            )
+        out = execute_subprocess(
+            [sys.executable, "-m", "accelerate_tpu", "launch", "--cpu",
+             "--max_restarts", "1", script],
+            env=_env(),
+        )
+        assert "second attempt ok" in out
+
+    def test_simple_no_restart_fails(self):
+        script = os.path.join(self.tmpdir, "fail.py")
+        with open(script, "w") as f:
+            f.write("import sys; sys.exit(3)\n")
+        with pytest.raises(RuntimeError, match="rc=3"):
+            execute_subprocess(
+                [sys.executable, "-m", "accelerate_tpu", "launch", "--cpu", script],
+                env=_env(),
+            )
+
+    def test_gang_teardown_on_dead_rank(self):
+        """rank 1 dies immediately; rank 0 would sleep forever — the monitor
+        must terminate it and exit (or restart) instead of hanging."""
+        script = os.path.join(self.tmpdir, "gang.py")
+        with open(script, "w") as f:
+            f.write(
+                "import os, sys, time\n"
+                "if os.environ['ACCELERATE_PROCESS_ID'] == '1':\n"
+                "    sys.exit(5)\n"
+                "time.sleep(600)\n"
+            )
+        import time
+
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="rc="):
+            execute_subprocess(
+                [sys.executable, "-m", "accelerate_tpu", "launch", "--cpu",
+                 "--num_processes", "2", "--monitor_interval", "0.2", script],
+                env=_env(),
+                timeout=120,
+            )
+        assert time.perf_counter() - t0 < 60, "gang teardown hung"
+
+
 class TestRequireDecorators:
     def test_require_cpu_runs_here(self):
         ran = []
