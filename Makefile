@@ -1,0 +1,40 @@
+# Developer targets (reference Makefile:25-72 test split analog).
+
+.PHONY: test test_core test_big_modeling test_cli test_examples test_multiprocess \
+        test_kernels native bench quality
+
+test:
+	python -m pytest tests/ -q
+
+# split targets for CI sharding
+test_core:
+	python -m pytest tests/ -q --ignore=tests/test_examples.py \
+	    --ignore=tests/test_big_modeling.py --ignore=tests/test_cli.py \
+	    --ignore=tests/test_multiprocess.py --ignore=tests/test_flash_attention.py \
+	    --ignore=tests/test_ring_attention.py --ignore=tests/test_fp8.py \
+	    --ignore=tests/test_quantization.py
+
+test_big_modeling:
+	python -m pytest tests/test_big_modeling.py tests/test_quantization.py -q
+
+test_cli:
+	python -m pytest tests/test_cli.py -q
+
+test_examples:
+	python -m pytest tests/test_examples.py -q
+
+test_multiprocess:
+	python -m pytest tests/test_multiprocess.py -q
+
+test_kernels:
+	python -m pytest tests/test_flash_attention.py tests/test_ring_attention.py tests/test_fp8.py -q
+
+native:
+	$(MAKE) -C accelerate_tpu/native
+
+bench:
+	python bench.py
+	python bench_inference.py
+
+quality:
+	python -m compileall -q accelerate_tpu

@@ -1,0 +1,71 @@
+"""Feature: automatic gradient accumulation (reference
+`examples/by_feature/automatic_gradient_accumulation.py`): combine
+`find_executable_batch_size` (OOM-halving retry) with gradient accumulation
+that GROWS to keep the effective batch constant — when the per-step batch
+halves, the accumulation steps double.
+
+Run:  python examples/by_feature/automatic_gradient_accumulation.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, find_executable_batch_size, set_seed
+from accelerate_tpu.state import AcceleratorState, GradientState
+from nlp_example import MAX_LEN, EncoderClassifier, get_dataloaders
+
+OBSERVED_BATCH_SIZES = []
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--target_effective_batch", type=int, default=64)
+    parser.add_argument("--starting_batch_size", type=int, default=64)
+    parser.add_argument("--fail_above", type=int, default=32,
+                        help="demo knob: batch sizes above this raise (simulated OOM)")
+    args = parser.parse_args()
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def training_loop(batch_size):
+        OBSERVED_BATCH_SIZES.append(batch_size)
+        # fresh singletons per attempt (each retry builds a new Accelerator,
+        # like the reference's inner-function pattern)
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        if batch_size > args.fail_above:
+            # stand-in for XlaRuntimeError RESOURCE_EXHAUSTED on small demo
+            # shapes (find_executable_batch_size catches real OOMs the same way)
+            raise MemoryError(f"simulated OOM at batch_size={batch_size}")
+        accum = max(1, args.target_effective_batch // batch_size)
+        accelerator = Accelerator(gradient_accumulation_steps=accum, mesh={"dp": -1})
+        set_seed(42)
+        train_dl, _ = get_dataloaders(accelerator, batch_size=batch_size)
+        model = EncoderClassifier()
+        params = model.init(jax.random.PRNGKey(42), jnp.zeros((1, MAX_LEN), jnp.int32))["params"]
+        state = accelerator.create_train_state(params=params, tx=optax.adamw(2e-4), seed=42)
+
+        def loss_fn(p, batch, rng=None):
+            logits = model.apply({"params": p}, batch["input_ids"])
+            return optax.softmax_cross_entropy(logits, jax.nn.one_hot(batch["labels"], 2)).mean()
+
+        step = accelerator.compile_train_step(loss_fn, max_grad_norm=1.0)
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+        accelerator.print(
+            f"trained with batch_size={batch_size} x accum={accum} "
+            f"(effective {batch_size * accum}); tried {OBSERVED_BATCH_SIZES}"
+        )
+        return state
+
+    training_loop()
+
+
+if __name__ == "__main__":
+    main()

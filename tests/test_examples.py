@@ -112,3 +112,21 @@ def test_feature_big_model_inference():
 def test_feature_profiler(tmp_path):
     out = run_example("by_feature/profiler.py", "--project_dir", str(tmp_path))
     assert "profile captured" in out
+
+
+def test_feature_multi_process_metrics():
+    out = run_example("by_feature/multi_process_metrics.py", "--num_epochs", "1")
+    assert "no duplicates counted" in out
+
+
+def test_feature_model_parallelism():
+    out = run_example("by_feature/model_parallelism.py", "--tp_degree", "2", "--steps", "10")
+    assert "column-parallel" in out and "tp" in out
+
+
+def test_feature_automatic_gradient_accumulation():
+    out = run_example("by_feature/automatic_gradient_accumulation.py")
+    # started at 64, simulated OOM drops to 32, accumulation doubles to keep
+    # the effective batch at 64
+    assert "batch_size=32 x accum=2" in out
+    assert "[64, 32]" in out
