@@ -492,10 +492,9 @@ class Accelerator:
                 )
                 return model
             recipe = self.fp8_recipe_handler
-            replacements = {
-                "use_fp8": True,
-                "fp8_margin": int(getattr(recipe, "margin", 0) or 0),
-            }
+            replacements = {"use_fp8": True}
+            if hasattr(cfg, "fp8_margin"):
+                replacements["fp8_margin"] = int(getattr(recipe, "margin", 0) or 0)
             if hasattr(cfg, "fp8_format"):
                 replacements["fp8_format"] = str(getattr(recipe, "fp8_format", "HYBRID"))
             return type(model)(_dc.replace(cfg, **replacements))

@@ -337,10 +337,27 @@ def load_checkpoint_and_dispatch(
         flat_shapes = quantize_flat(flat_shapes, quantization, sep=SEP)
     abstract = unflatten_tree(flat_shapes)
 
-    def read(keys):
+    def read(keys, host: bool = False):
         flat = _read_tensors(files, keys, dtype)
         if quantize_flat is not None:
-            flat = quantize_flat(flat, quantization, sep=SEP)
+            if host:
+                # cpu-targeted modules must quantize on the host: the jnp ops in
+                # quantize() otherwise commit qweight/scales to the default
+                # accelerator device, putting the whole "bigger than HBM" model
+                # in HBM during load — and jax.Array leaves would also disable
+                # the StreamingExecutor's packed host-transfer path.
+                import contextlib
+
+                try:
+                    cpu = jax.local_devices(backend="cpu")[0]
+                    ctx = jax.default_device(cpu)
+                except RuntimeError:
+                    ctx = contextlib.nullcontext()
+                with ctx:
+                    flat = quantize_flat(flat, quantization, sep=SEP)
+                flat = {k: np.asarray(v) for k, v in flat.items()}
+            else:
+                flat = quantize_flat(flat, quantization, sep=SEP)
         return flat
 
     if device_map == "sharded":
@@ -376,7 +393,7 @@ def load_checkpoint_and_dispatch(
                 safetensors_refs[k] = files[k]
             placed[mod] = None
         elif target == "cpu":
-            flat = read(keys)
+            flat = read(keys, host=True)
             host_entries.update(flat)
             placed[mod] = _strip_prefix(flat, mod)
         else:

@@ -321,8 +321,11 @@ def save_model(
         accelerator.wait_for_everyone()
         return []
     if save_dtype is not None:
+        # jnp.issubdtype (not np.) — ml_dtypes bfloat16/float8 register as
+        # floating only through jax's extended dtype lattice, and bf16 weights
+        # are the common case here.
         host = jax.tree_util.tree_map(
-            lambda x: x.astype(save_dtype) if np.issubdtype(x.dtype, np.floating) else x,
+            lambda x: x.astype(save_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             host,
         )
     os.makedirs(save_directory, exist_ok=True)

@@ -110,6 +110,13 @@ class AcceleratedOptimizer:
             "step": int(jax.device_get(state.step)),
             "micro_step": int(jax.device_get(state.micro_step)),
         }
+        if state.grad_accum is not None:
+            # micro_step only means something together with the accumulation
+            # buffer it indexes: snapshot both or the next sync step would
+            # average over phantom micro-steps.
+            sd["grad_accum"] = jax.tree_util.tree_map(
+                np.asarray, jax.device_get(state.grad_accum)
+            )
         if state.loss_scale is not None:
             sd["loss_scale"] = {
                 "scale": float(jax.device_get(state.loss_scale.scale)),
@@ -136,10 +143,24 @@ class AcceleratedOptimizer:
             return val
 
         new_opt = jax.tree_util.tree_map(place, state.opt_state, state_dict["opt_state"])
+        micro_step = int(state_dict.get("micro_step", 0))
+        accum_snapshot = state_dict.get("grad_accum")
+        if state.grad_accum is not None and accum_snapshot is not None:
+            new_accum = jax.tree_util.tree_map(place, state.grad_accum, accum_snapshot)
+        elif state.grad_accum is not None:
+            # Legacy snapshot without its buffer: accumulation progress is not
+            # preserved. Zero the buffer (the live state's may hold pre-restore
+            # gradients) and restart the window — a nonzero micro_step without
+            # its gradient sum would mis-scale the next update.
+            new_accum = jax.tree_util.tree_map(jnp.zeros_like, state.grad_accum)
+            micro_step = 0
+        else:
+            new_accum = None
         new_state = state.replace(
             opt_state=new_opt,
             step=jnp.asarray(state_dict.get("step", 0), dtype=jnp.int32),
-            micro_step=jnp.asarray(state_dict.get("micro_step", 0), dtype=jnp.int32),
+            micro_step=jnp.asarray(micro_step, dtype=jnp.int32),
+            grad_accum=new_accum,
         )
         ls = state_dict.get("loss_scale")
         if ls is not None and state.loss_scale is not None:
