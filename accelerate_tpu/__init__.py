@@ -35,6 +35,14 @@ from .big_modeling import (
     shard_params_for_inference,
 )
 from .launchers import debug_launcher, notebook_launcher
+from .models import (
+    GenerationConfig,
+    KVCache,
+    generate,
+    make_decode_step,
+    make_prefill_step,
+    sample_tokens,
+)
 from .ops import (
     Int4Config,
     Int8Config,

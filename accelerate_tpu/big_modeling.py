@@ -703,6 +703,19 @@ class StreamingTransformer(StreamingExecutor):
                 x = DecoderLayer(cfg).apply({"params": lp}, x, positions)
             return x, positions
 
+        def cached_layer_fn(chunk_params, x, positions, ks, vs, index):
+            # decode-mode stage: each layer reads/writes its own (k, v) cache
+            # at the shared position index; caches stay in HBM across tokens —
+            # only the weights stream.
+            new_ks, new_vs = [], []
+            for lp, k_c, v_c in zip(chunk_params, ks, vs):
+                x, (nk, nv) = DecoderLayer(cfg).apply(
+                    {"params": lp}, x, positions, cache=(k_c, v_c, index)
+                )
+                new_ks.append(nk)
+                new_vs.append(nv)
+            return x, tuple(new_ks), tuple(new_vs)
+
         def embed_fn(embed_params, ids, positions):
             import flax.linen as nn
 
@@ -726,6 +739,10 @@ class StreamingTransformer(StreamingExecutor):
             tuple(range(start, min(start + k, cfg.num_layers)))
             for start in range(0, cfg.num_layers, k)
         ]
+        self._chunks = chunks
+        self._embed_fn = embed_fn
+        self._head_fn = head_fn
+        self._cached_layer_fn = cached_layer_fn
         plan = make_layer_plan(
             embed=("embed_tokens", embed_fn),
             layers=[
@@ -777,5 +794,127 @@ class StreamingTransformer(StreamingExecutor):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1])[None, :], input_ids.shape)
         return super().__call__(input_ids, positions)
+
+    # -- autoregressive decode (weights stream per token, cache stays in HBM) --
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Per-chunk KV caches on the exec device: ``{"chunks": [(ks, vs), ...],
+        "index": scalar}`` where ks/vs are per-layer ``[B, max_len, Hkv, D]``.
+
+        Unlike the monolithic :class:`~accelerate_tpu.models.transformer.KVCache`
+        (stacked over depth), chunk-grained caches keep ONE decode executable
+        per chunk size and let each stage carry only its own slice.
+        """
+        cfg = self.config
+        dtype = dtype if dtype is not None else getattr(cfg, "dtype", jnp.bfloat16)
+        hd = cfg.resolved_head_dim
+        shape = (batch_size, max_len, cfg.num_kv_heads, hd)
+        chunks = []
+        for c in self._chunks:
+            ks = tuple(jax.device_put(jnp.zeros(shape, dtype), self.device) for _ in c)
+            vs = tuple(jax.device_put(jnp.zeros(shape, dtype), self.device) for _ in c)
+            chunks.append((ks, vs))
+        return {
+            "chunks": chunks,
+            "index": jax.device_put(jnp.zeros((), jnp.int32), self.device),
+        }
+
+    def forward_with_cache(self, input_ids, cache):
+        """Incremental forward (prefill S>1 or decode S=1) with the streaming
+        schedule: stage ``i+1``'s weights transfer while stage ``i`` computes.
+        Returns ``(logits [B,S,V], new_cache)``."""
+        input_ids = jnp.asarray(input_ids)
+        if self._scan_layout and not (isinstance(self.params, dict) and "layers" in self.params):
+            self._stack_cache = None
+        index = cache["index"]
+        s = input_ids.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], input_ids.shape) + index
+        transfer_cache: Dict[int, Any] = {}
+        n = len(self.plan)
+        current = self._prepare_stage(0, transfer_cache)
+        x = pos = logits = None
+        new_chunks = []
+        for i in range(n):
+            nxt = self._prepare_stage(i + 1, transfer_cache) if i + 1 < n else None
+            operand, spec, treedef = current
+            if i == 0:
+                x, pos = self._run_stage(
+                    self._embed_fn, operand, spec, treedef, (input_ids, positions)
+                )
+            elif i == n - 1:
+                logits = self._run_stage(self._head_fn, operand, spec, treedef, (x, pos))
+            else:
+                ks, vs = cache["chunks"][i - 1]
+                x, nks, nvs = self._run_stage(
+                    self._cached_layer_fn, operand, spec, treedef, (x, pos, ks, vs, index)
+                )
+                new_chunks.append((nks, nvs))
+            current = nxt
+        return logits, {"chunks": new_chunks, "index": index + s}
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: int = 128,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+        rng=None,
+        cache=None,
+    ) -> np.ndarray:
+        """Host-driven token loop over :meth:`forward_with_cache` — the
+        reference's published benchmark workload (generation under CPU/disk
+        offload, ``benchmarks/big_model_inference.py:141-155``): every token
+        streams the weights once, double-buffered against compute.
+
+        Returns ``[B, S + max_new_tokens]`` numpy token ids (EOS lanes padded).
+        """
+        import functools as _ft
+
+        from .models.generation import sample_tokens
+
+        input_ids = jnp.asarray(input_ids)
+        b, s = input_ids.shape
+        if cache is None:
+            cache = self.init_cache(b, s + max_new_tokens)
+        else:
+            used = int(jax.device_get(cache["index"]))
+            max_len = cache["chunks"][0][0][0].shape[1]
+            if used + s + max_new_tokens > max_len:
+                raise ValueError(
+                    f"cache max_len {max_len} < {used} already written + prompt {s} + "
+                    f"max_new_tokens {max_new_tokens}; init_cache with max_len >= "
+                    f"{used + s + max_new_tokens} (dynamic_update_slice would clamp "
+                    "out-of-range writes and silently corrupt the cache)"
+                )
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        sample = jax.jit(
+            _ft.partial(
+                sample_tokens,
+                do_sample=do_sample, temperature=temperature, top_k=top_k, top_p=top_p,
+            )
+        )
+        logits, cache = self.forward_with_cache(input_ids, cache)
+        rng, sub = jax.random.split(rng)
+        tok = np.asarray(sample(logits[:, -1], sub))
+        done = np.zeros(b, dtype=bool)
+        if eos_token_id is not None:
+            done |= tok == eos_token_id
+        toks = [tok]
+        for _ in range(max_new_tokens - 1):
+            if done.all():
+                toks.append(np.full((b,), pad_token_id, dtype=tok.dtype))
+                continue
+            logits, cache = self.forward_with_cache(jnp.asarray(toks[-1])[:, None], cache)
+            rng, sub = jax.random.split(rng)
+            nxt = np.asarray(sample(logits[:, -1], sub))
+            nxt = np.where(done, pad_token_id, nxt)
+            if eos_token_id is not None:
+                done |= nxt == eos_token_id
+            toks.append(nxt)
+        return np.concatenate([np.asarray(input_ids), np.stack(toks, axis=1)], axis=1)
 
 

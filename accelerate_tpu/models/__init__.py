@@ -1,1 +1,17 @@
 """models subpackage."""
+
+from .generation import GenerationConfig, generate, make_decode_step, make_prefill_step, sample_tokens
+from .transformer import KVCache, Transformer, TransformerConfig, cross_entropy_loss, lm_loss_fn
+
+__all__ = [
+    "GenerationConfig",
+    "KVCache",
+    "Transformer",
+    "TransformerConfig",
+    "cross_entropy_loss",
+    "generate",
+    "lm_loss_fn",
+    "make_decode_step",
+    "make_prefill_step",
+    "sample_tokens",
+]

@@ -1,0 +1,190 @@
+"""Autoregressive generation: KV-cache decode loop + sampling.
+
+The reference's only *published* benchmark is token generation — s/token for
+big offloaded models (``/root/reference/benchmarks/big_model_inference.py:141-155``,
+``benchmarks/README.md:27-37``) — delegated there to ``transformers``'
+``model.generate`` over torch modules.  TPU-native generation is instead one
+compiled program:
+
+  * the KV cache is a static-shape pytree (:class:`~.transformer.KVCache`)
+    updated in place at a *traced* position index, so a single decode
+    executable serves every token;
+  * the decode loop is ``lax.scan`` inside one ``jit`` — no per-token python,
+    no retracing, cache donated so XLA aliases the update buffers;
+  * sampling (greedy / temperature / top-k / top-p) is pure ``jnp`` and lives
+    inside the same program; EOS early-stop is done by masking (done lanes emit
+    ``pad_token_id``) because data-dependent loop exit would break the static
+    schedule.
+
+For weights that do not fit in HBM, the same ``decode_step`` shape is driven
+per-token by :class:`~accelerate_tpu.big_modeling.StreamingTransformer`, which
+streams layer weights host→HBM under the token loop (the AlignDevicesHook
+workload, reference ``hooks.py:322-389``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import KVCache, Transformer
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Decode-loop knobs (the transformers ``GenerationConfig`` analog, reduced
+    to what a jittable loop can honor)."""
+
+    max_new_tokens: int = 128
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+def sample_tokens(
+    logits: jax.Array,
+    rng: Optional[jax.Array] = None,
+    *,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """``[B, V] logits -> [B] int32 tokens``; jit-safe (static shapes only).
+
+    Greedy unless ``do_sample``; with sampling, temperature then top-k then
+    top-p filters apply in the usual order (matching transformers'
+    ``LogitsProcessor`` pipeline semantics).
+    """
+    if not do_sample or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("do_sample=True needs an rng key")
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    neg_inf = jnp.finfo(jnp.float32).min
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # a slot is OUTSIDE the nucleus when the mass before it already reaches
+        # top_p; the first slot is always kept
+        outside = (cum - probs) >= top_p
+        min_kept = jnp.min(
+            jnp.where(outside, jnp.inf, sorted_desc), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < min_kept, neg_inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def make_prefill_step(model: Transformer):
+    """Jitted ``(params, input_ids, cache) -> (logits, cache)`` over the prompt."""
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, input_ids, cache):
+        return model.apply({"params": params}, input_ids, cache=cache)
+
+    return prefill
+
+
+def make_decode_step(model: Transformer):
+    """Jitted single-token step ``(params, tokens [B], cache) -> (logits [B,V], cache)``.
+
+    The cache is donated: XLA updates it in place, so per-token cost is the
+    weight reads + one cache-line write, not a cache copy.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def decode(params, tokens, cache):
+        logits, cache = model.apply({"params": params}, tokens[:, None], cache=cache)
+        return logits[:, -1], cache
+
+    return decode
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_generate(model: Transformer, gen: GenerationConfig, prompt_len: int,
+                       total_len: int):
+    """One fused program: prefill + scan over max_new_tokens decode steps."""
+
+    def run(params, input_ids, cache, rng):
+        logits, cache = model.apply({"params": params}, input_ids, cache=cache)
+        rng, sub = jax.random.split(rng)
+        tok = sample_tokens(
+            logits[:, -1], sub, do_sample=gen.do_sample, temperature=gen.temperature,
+            top_k=gen.top_k, top_p=gen.top_p,
+        )
+        done = (
+            tok == gen.eos_token_id
+            if gen.eos_token_id is not None
+            else jnp.zeros(tok.shape, bool)
+        )
+
+        def step(carry, _):
+            cache, tok, rng, done = carry
+            logits, cache = model.apply({"params": params}, tok[:, None], cache=cache)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_tokens(
+                logits[:, -1], sub, do_sample=gen.do_sample,
+                temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
+            )
+            nxt = jnp.where(done, gen.pad_token_id, nxt)
+            if gen.eos_token_id is not None:
+                done = done | (nxt == gen.eos_token_id)
+            return (cache, nxt, rng, done), nxt
+
+        (cache, _, _, _), rest = jax.lax.scan(
+            step, (cache, tok, rng, done), None, length=gen.max_new_tokens - 1
+        )
+        seq = jnp.concatenate([input_ids, tok[:, None], rest.T.astype(input_ids.dtype)], axis=1)
+        return seq, cache
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def generate(
+    model: Transformer,
+    params,
+    input_ids,
+    generation_config: Optional[GenerationConfig] = None,
+    rng: Optional[jax.Array] = None,
+    cache: Optional[KVCache] = None,
+    **overrides: Any,
+):
+    """Generate ``max_new_tokens`` continuations of ``input_ids`` [B, S].
+
+    Returns ``(sequences [B, S + max_new_tokens], cache)``.  Lanes that hit
+    ``eos_token_id`` emit ``pad_token_id`` for the remainder (static shapes).
+    The whole loop is one cached executable per (model, config, shape) triple.
+    """
+    gen = generation_config or GenerationConfig()
+    if overrides:
+        gen = dataclasses.replace(gen, **overrides)
+    b, s = input_ids.shape
+    total = s + gen.max_new_tokens
+    if cache is None:
+        cache = KVCache.create(model.config, b, total)
+    else:
+        # account for already-written entries: dynamic_update_slice CLAMPS
+        # out-of-range writes, which would silently corrupt the cache
+        used = int(jax.device_get(cache.index))
+        if used + total > cache.max_len:
+            raise ValueError(
+                f"cache max_len {cache.max_len} < {used} already written + prompt {s} "
+                f"+ max_new_tokens {gen.max_new_tokens}; create the cache with "
+                f"max_len >= {used + total}"
+            )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _compiled_generate(model, gen, s, cache.max_len)(
+        params, jnp.asarray(input_ids), cache, rng
+    )

@@ -23,6 +23,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from ..ops.attention import dot_product_attention
 
@@ -104,6 +105,63 @@ class TransformerConfig:
                              max_seq_len=128, num_experts=4, num_experts_per_tok=2), **kw})
 
 
+class KVCache(struct.PyTreeNode):
+    """Static-shape KV cache for autoregressive decode.
+
+    The reference's published benchmark is token generation
+    (``/root/reference/benchmarks/big_model_inference.py:141-155``); its cache
+    lives inside transformers' dynamic python objects.  TPU-first the cache is
+    one pytree of fixed-shape arrays — ``[num_layers, batch, max_len, kv_heads,
+    head_dim]`` — written in place with ``lax.dynamic_update_slice`` at a
+    traced position index, so ONE decode executable serves every token and XLA
+    aliases the update when the cache is donated.
+    """
+
+    k: jax.Array            # [L, B, max_len, n_kv_heads, head_dim]
+    v: jax.Array            # [L, B, max_len, n_kv_heads, head_dim]
+    index: jax.Array        # scalar int32: next write position (= tokens seen)
+
+    @classmethod
+    def create(cls, config: "TransformerConfig", batch_size: int, max_len: Optional[int] = None,
+               dtype: Any = None) -> "KVCache":
+        max_len = max_len if max_len is not None else config.max_seq_len
+        shape = (config.num_layers, batch_size, max_len,
+                 config.num_kv_heads, config.resolved_head_dim)
+        dtype = dtype if dtype is not None else config.dtype
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            index=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def cached_attention(q, k, v, q_positions):
+    """Attention of ``q`` [B,S,Hq,D] against a full cache ``k``/``v`` [B,M,Hkv,D].
+
+    Key slot ``j`` is visible to query ``i`` iff ``j <= q_positions[i]`` —
+    since the cache is written contiguously from 0, this is simultaneously the
+    causal mask and the valid-entry mask (unwritten slots have ``j`` beyond
+    every query position).  Runs as a masked einsum: decode queries are tiny
+    (S=1) and prefill blocks fuse fine on the MXU; fp32 softmax.
+    """
+    n_q, n_kv = q.shape[2], k.shape[2]
+    if n_kv != n_q:
+        rep = n_q // n_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    j = jnp.arange(k.shape[1])
+    mask = j[None, None, None, :] <= q_positions[:, None, :, None]  # [B,1,S,M]
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding over the last dim of [B, S, H, D]."""
     d = x.shape[-1]
@@ -132,7 +190,11 @@ class Attention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None):
+        """``cache`` is ``(k_cache [B,M,Hkv,D], v_cache, index)`` for this layer;
+        when given, new k/v are written at ``index`` (post-rope, so cached keys
+        never need re-rotation) and the call returns ``(out, (new_k_cache,
+        new_v_cache))``."""
         cfg = self.config
         hd = cfg.resolved_head_dim
         dense = functools_partial_dense(cfg)
@@ -145,6 +207,17 @@ class Attention(nn.Module):
         v = v.reshape(b, s, cfg.num_kv_heads, hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+        if cache is not None:
+            k_cache, v_cache, index = cache
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, index, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, index, 0, 0)
+            )
+            out = cached_attention(q, k_cache, v_cache, positions)
+            out = out.reshape(b, s, cfg.num_heads * hd)
+            return dense("o_proj", cfg.hidden_size)(out), (k_cache, v_cache)
         out = dot_product_attention(
             q, k, v, causal=True, implementation=cfg.attention_impl, segment_ids=segment_ids
         )
@@ -212,11 +285,16 @@ class DecoderLayer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None):
         cfg = self.config
-        x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x), positions
+        attn_out = Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x), positions,
+            cache=cache,
         )
+        new_kv = None
+        if cache is not None:
+            attn_out, new_kv = attn_out
+        x = x + attn_out
         if cfg.num_experts > 0:
             from ..parallel.moe import MoEMLP
 
@@ -224,21 +302,29 @@ class DecoderLayer(nn.Module):
         else:
             mlp = MLP(cfg, name="mlp")
         x = x + mlp(RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x))
-        return x
+        return x if cache is None else (x, new_kv)
 
 
 class Transformer(nn.Module):
-    """Decoder-only LM.  ``__call__(input_ids [B,S]) -> logits [B,S,V]``."""
+    """Decoder-only LM.  ``__call__(input_ids [B,S]) -> logits [B,S,V]``.
+
+    With ``cache=``\\ :class:`KVCache` the call is an incremental forward:
+    positions default to ``cache.index + arange(S)``, each layer reads/writes
+    its cache slice, and the result is ``(logits, new_cache)`` — the substrate
+    for :mod:`accelerate_tpu.models.generation`.
+    """
 
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, cache: Optional[KVCache] = None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
             )
+            if cache is not None:
+                positions = positions + cache.index
         embed = nn.Embed(
             cfg.vocab_size,
             cfg.hidden_size,
@@ -249,11 +335,13 @@ class Transformer(nn.Module):
         )
         x = embed(input_ids)
 
+        new_cache = None
         if cfg.scan_layers:
             # Roll layers into one scanned module: params stack on axis 0,
             # compile time is O(1) in depth, and stages slice cleanly for PP.
+            # The KV cache scans right along (in/out axis 0 = depth).
             body = ScanBody
-            if cfg.remat:
+            if cfg.remat and cache is None:
                 body = nn.remat(ScanBody, prevent_cse=False)
             ScanLayers = nn.scan(
                 body,
@@ -262,15 +350,36 @@ class Transformer(nn.Module):
                 variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
-                in_axes=(nn.broadcast,),
+                in_axes=(nn.broadcast, nn.broadcast, 0),
             )
-            x, _ = ScanLayers(cfg, name="layers")(x, positions)
+            kv_in = (None, None) if cache is None else (cache.k, cache.v)
+            x, kv_out = ScanLayers(cfg, name="layers")(
+                x, positions, None if cache is None else cache.index, kv_in
+            )
+            if cache is not None:
+                new_cache = cache.replace(
+                    k=kv_out[0], v=kv_out[1], index=cache.index + input_ids.shape[1]
+                )
         else:
             layer_cls = DecoderLayer
-            if cfg.remat:
+            if cfg.remat and cache is None:
                 layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+            new_ks, new_vs = [], []
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+                if cache is None:
+                    x = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+                else:
+                    x, (k_i, v_i) = layer_cls(cfg, name=f"layers_{i}")(
+                        x, positions, cache=(cache.k[i], cache.v[i], cache.index)
+                    )
+                    new_ks.append(k_i)
+                    new_vs.append(v_i)
+            if cache is not None:
+                new_cache = cache.replace(
+                    k=jnp.stack(new_ks),
+                    v=jnp.stack(new_vs),
+                    index=cache.index + input_ids.shape[1],
+                )
 
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
         if cfg.tie_word_embeddings:
@@ -284,17 +393,23 @@ class Transformer(nn.Module):
                 kernel_init=nn.initializers.normal(0.02),
                 name="lm_head",
             )(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return logits if cache is None else (logits, new_cache)
 
 
 class ScanBody(nn.Module):
-    """Scan-compatible layer body: carry = hidden states, broadcast = positions."""
+    """Scan-compatible layer body: carry = hidden states; positions/cache index
+    broadcast; per-layer KV cache slices scanned on axis 0 (depth)."""
 
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        return DecoderLayer(self.config, name="layer")(x, positions), None
+    def __call__(self, x, positions, cache_index=None, kv=(None, None)):
+        layer = DecoderLayer(self.config, name="layer")
+        if kv[0] is None:
+            return layer(x, positions), None
+        x, new_kv = layer(x, positions, cache=(kv[0], kv[1], cache_index))
+        return x, new_kv
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100, z_loss: float = 0.0):
