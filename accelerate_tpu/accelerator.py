@@ -593,6 +593,13 @@ class Accelerator:
             shape_opt_rule = make_opt_sharding_fn(self.mesh, plugin)
             param_rule = lambda path, x: shape_param_rule(x)
             opt_rule = lambda path, x: shape_opt_rule(x)
+        if mesh_lib.mesh_axis_size(self.mesh, "pp") > 1:
+            # scan-stacked layer params shard their depth axis over pp so each
+            # pipeline stage owns its layer slice at rest (no per-step reshard)
+            from .parallel.tensor_parallel import wrap_with_pp_rule
+
+            param_rule = wrap_with_pp_rule(param_rule, self.mesh)
+            opt_rule = wrap_with_pp_rule(opt_rule, self.mesh)
         replicated = NamedSharding(self.mesh, PartitionSpec())
 
         ep_size = mesh_lib.mesh_axis_size(self.mesh, "ep")
@@ -781,6 +788,16 @@ class Accelerator:
         gradient buffer — semantics of reference ``accumulate()``/``no_sync``
         (``accelerator.py:912-1069``) without the Python-side no_sync dance.
         """
+        pp_size = mesh_lib.mesh_axis_size(self.mesh, "pp")
+        if pp_size > 1 and not getattr(loss_fn, "_pp_aware", False):
+            raise ValueError(
+                f"The mesh has a pp axis of size {pp_size} but this loss_fn has no "
+                "pipeline schedule: the pp devices would silently replicate compute. "
+                "Build the loss with accelerate_tpu.parallel.pipeline_lm_loss_fn(model) "
+                "(or mark a custom loss that microbatch-schedules over the pp axis "
+                "with `loss_fn._pp_aware = True`), or drop pp_degree from "
+                "ModelParallelPlugin."
+            )
         wrapped_loss = self._wrap_loss_fn(loss_fn, has_aux)
         wrapped_loss = self._maybe_remat(wrapped_loss)
         accum = self.gradient_accumulation_steps

@@ -871,9 +871,7 @@ class StreamingTransformer(StreamingExecutor):
 
         Returns ``[B, S + max_new_tokens]`` numpy token ids (EOS lanes padded).
         """
-        import functools as _ft
-
-        from .models.generation import sample_tokens
+        from .models.generation import make_sampler
 
         input_ids = jnp.asarray(input_ids)
         b, s = input_ids.shape
@@ -891,11 +889,8 @@ class StreamingTransformer(StreamingExecutor):
                 )
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        sample = jax.jit(
-            _ft.partial(
-                sample_tokens,
-                do_sample=do_sample, temperature=temperature, top_k=top_k, top_p=top_p,
-            )
+        sample = make_sampler(
+            do_sample=do_sample, temperature=temperature, top_k=top_k, top_p=top_p
         )
         logits, cache = self.forward_with_cache(input_ids, cache)
         rng, sub = jax.random.split(rng)

@@ -86,6 +86,25 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+@functools.lru_cache(maxsize=32)
+def make_sampler(do_sample: bool = False, temperature: float = 1.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None):
+    """Jitted ``(logits [B,V], rng) -> tokens [B]`` for fixed sampling knobs.
+
+    Cached so repeated ``generate`` calls (serving loops, the streaming
+    decoder) reuse one executable instead of retracing per call.
+    """
+
+    @jax.jit
+    def sample(logits, rng):
+        return sample_tokens(
+            logits, rng, do_sample=do_sample, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        )
+
+    return sample
+
+
 def make_prefill_step(model: Transformer):
     """Jitted ``(params, input_ids, cache) -> (logits, cache)`` over the prompt."""
 

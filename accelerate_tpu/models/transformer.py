@@ -146,20 +146,23 @@ def cached_attention(q, k, v, q_positions):
     since the cache is written contiguously from 0, this is simultaneously the
     causal mask and the valid-entry mask (unwritten slots have ``j`` beyond
     every query position).  Runs as a masked einsum: decode queries are tiny
-    (S=1) and prefill blocks fuse fine on the MXU; fp32 softmax.
+    (S=1) and prefill blocks fuse fine on the MXU; fp32 softmax.  GQA groups
+    fold into the query tensor (``[B,S,Hkv,rep,D]``) so the cache is contracted
+    UNexpanded — a ``jnp.repeat`` of K/V would multiply the per-token HBM reads
+    by the query/kv head ratio on the decode hot path.
     """
-    n_q, n_kv = q.shape[2], k.shape[2]
-    if n_kv != n_q:
-        rep = n_q // n_kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    b, s, n_q, d = q.shape
+    n_kv = k.shape[2]
+    rep = n_q // n_kv
+    qg = q.reshape(b, s, n_kv, rep, d)
+    scale = d ** -0.5
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
     j = jnp.arange(k.shape[1])
-    mask = j[None, None, None, :] <= q_positions[:, None, :, None]  # [B,1,S,M]
+    mask = j[None, None, None, None, :] <= q_positions[:, None, None, :, None]  # [B,1,1,S,M]
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
+    return out.reshape(b, s, n_q, d)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:

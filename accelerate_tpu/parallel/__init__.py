@@ -1,7 +1,7 @@
 """Parallelism substrate: named meshes, sharding rules, collectives, model parallel."""
 
 from .moe import MoEMLP, router_aux_loss, shard_moe_params, top_k_dispatch
-from .pipeline import pipeline_apply, prepare_pipeline, stack_layer_params
+from .pipeline import pipeline_apply, pipeline_lm_loss_fn, prepare_pipeline, stack_layer_params
 from .ring_attention import (
     ring_attention,
     ring_attention_sharded,

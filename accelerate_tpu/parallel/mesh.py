@@ -125,9 +125,14 @@ def build_mesh(
     return Mesh(dev_array, names)
 
 
+def present_data_axes(mesh: Mesh) -> tuple:
+    """The data axes this mesh actually has (size > 1)."""
+    return tuple(a for a in DATA_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
 def data_partition_spec(mesh: Mesh) -> PartitionSpec:
     """PartitionSpec sharding batch dim 0 over every data axis present in the mesh."""
-    present = tuple(a for a in DATA_AXES if a in mesh.axis_names and mesh.shape[a] > 1)
+    present = present_data_axes(mesh)
     if not present:
         return PartitionSpec()
     return PartitionSpec(present)

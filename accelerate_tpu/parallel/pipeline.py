@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import mesh_axis_size
+from .mesh import mesh_axis_size, present_data_axes
 
 
 def stack_layer_params(params: dict, num_layers: int) -> Any:
@@ -58,6 +58,11 @@ def pipeline_apply(
     that shard_map splits across stages.  ``microbatches`` is ``[M, mb, ...]``
     (replicated across ``axis``); the output has the same shape.  ``M`` should
     be >= the pp degree to keep the bubble fraction (pp-1)/(M+pp-1) small.
+
+    When the mesh also has data axes (``dp``/``fsdp``), the per-microbatch
+    batch dim (dim 1 of ``microbatches``, dim 0 of every broadcast arg) shards
+    over them, so PP composes with data parallelism instead of replicating the
+    batch across those devices.
     """
     n_stages = mesh_axis_size(mesh, axis)
     num_micro = microbatches.shape[0]
@@ -98,14 +103,72 @@ def pipeline_apply(
         return lax.psum(have, axis)
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
+    data = present_data_axes(mesh)
+    if data:
+        n_data = 1
+        for a in data:
+            n_data *= mesh.shape[a]
+        mb_size = microbatches.shape[1]
+        if mb_size % n_data:
+            raise ValueError(
+                f"Per-microbatch batch {mb_size} does not divide the data axes "
+                f"{dict((a, mesh.shape[a]) for a in data)} (= {n_data} shards); "
+                "use fewer microbatches or a larger global batch."
+            )
+    mb_spec = P(None, data) if data else P()
+    barg_spec = P(data) if data else P()
     n_bargs = len(broadcast_args)
     return jax.shard_map(
         worker,
         mesh=mesh,
-        in_specs=(param_specs, P()) + (P(),) * n_bargs,
-        out_specs=P(),
+        in_specs=(param_specs, mb_spec) + (barg_spec,) * n_bargs,
+        out_specs=mb_spec,
         check_vma=False,
     )(layer_params, microbatches, *broadcast_args)
+
+
+def pipeline_lm_loss_fn(
+    model,
+    mesh: Optional[Mesh] = None,
+    num_microbatches: Optional[int] = None,
+    axis: str = "pp",
+):
+    """Next-token LM loss with the decoder stack pipelined over ``mesh[axis]``
+    — the trainer-integrated PP path (the reference trains PP only through
+    Megatron's ``pp_degree``, ``utils/dataclasses.py:1318``).
+
+    Drop-in for :func:`~accelerate_tpu.models.transformer.lm_loss_fn` inside
+    ``Accelerator.compile_train_step``: the whole GPipe schedule (microbatch
+    scan + ``ppermute`` rotation) sits inside the loss, so fwd+bwd autodiff
+    gives the reversed backward pipeline and gradient accumulation/clipping/
+    optimizer update compose unchanged.  The function is marked ``_pp_aware``;
+    ``compile_train_step`` REJECTS non-aware losses on a pp>1 mesh rather than
+    silently replicating compute across the pp devices.
+    """
+    from ..models.transformer import cross_entropy_loss
+
+    cfg = model.config
+    if getattr(cfg, "num_experts", 0) > 0:
+        raise NotImplementedError(
+            "pipeline_lm_loss_fn does not support MoE configs: the router aux "
+            "loss is sown outside the pipelined stack. Use ep-sharding for MoE "
+            "models (ModelParallelPlugin(expert_parallel_degree=...))."
+        )
+    forward = prepare_pipeline(
+        model, None, mesh=mesh, num_microbatches=num_microbatches, axis=axis, jit=False
+    )
+
+    def loss_fn(params, batch, rng=None):
+        logits = forward(params, batch["input_ids"])
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.pad(
+                batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100
+            )
+        return cross_entropy_loss(logits, labels)
+
+    loss_fn._pp_aware = True
+    return loss_fn
 
 
 def prepare_pipeline(
@@ -127,11 +190,20 @@ def prepare_pipeline(
     import flax.linen as nn
 
     cfg = model.config
-    if mesh is None:
+
+    def resolve_mesh() -> Mesh:
+        # LAZY: resolved at trace/call time, not construction time — a loss
+        # built before its Accelerator must bind the pp mesh that is active
+        # when the step compiles, not whatever mesh (or none) existed earlier.
+        if mesh is not None:
+            return mesh
         from ..state import PartialState
 
-        mesh = PartialState().mesh
-    if num_microbatches is None:
+        return PartialState().mesh
+
+    def resolve_num_microbatches() -> int:
+        if num_microbatches is not None:
+            return num_microbatches
         # default from the active ModelParallelPlugin (reference MegatronLMPlugin
         # num_micro_batches / pippy num_chunks), else the classic GPipe 8
         from ..state import AcceleratorState
@@ -141,7 +213,7 @@ def prepare_pipeline(
             if AcceleratorState._shared_state
             else None
         )
-        num_microbatches = plugin.num_micro_batches if plugin is not None else 8
+        return plugin.num_micro_batches if plugin is not None else 8
 
     def stage_fn(local_layers, x, positions):
         def body(h, layer_params):
@@ -151,6 +223,8 @@ def prepare_pipeline(
         return x
 
     def forward(p, input_ids):
+        mesh = resolve_mesh()
+        num_microbatches = resolve_num_microbatches()
         b, s = input_ids.shape
         if b % num_microbatches:
             raise ValueError(f"Batch {b} not divisible by {num_microbatches} microbatches")

@@ -28,12 +28,16 @@ from . import mesh as mesh_lib
 from .sharding import _named_sharding, make_opt_sharding_fn, make_param_sharding_fn, supports_host_offload
 
 # (pattern, which of the last two dims takes the tp axis): "out" = column-parallel
-# (shard the output features), "in" = row-parallel (shard the reduction dim).
+# (shard the output features), "in" = row-parallel (shard the reduction dim),
+# "vocab" = vocab-parallel embedding (tp AND fsdp stack on the vocab dim; the
+# hidden dim stays replicated — fsdp-sharding it forces the embedding-gradient
+# scatter to reshard the batch-sharded input cotangent onto the hidden dim,
+# which XLA's SPMD partitioner can only do by full rematerialization).
 DEFAULT_TP_RULES: Tuple[Tuple[str, str], ...] = (
     (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)/kernel$", "out"),
     (r"(o_proj|down_proj)/kernel$", "in"),
     # embedding [vocab, hidden]: vocab-parallel (Megatron VocabParallelEmbedding)
-    (r"embed_tokens/embedding$", "in"),
+    (r"embed_tokens/embedding$", "vocab"),
 )
 
 
@@ -81,14 +85,26 @@ def make_tp_sharding_fn(
 
     def rule(path, x) -> NamedSharding:
         shape = getattr(x, "shape", ())
-        if tp > 1 and len(shape) >= 2:
+        if tp > 1 and len(shape) >= 2:  # noqa: SIM102 (kept flat for readability)
             p = path_to_str(path)
             for pat, kind in compiled:
                 if pat.search(p):
                     tp_dim = len(shape) - 1 if kind == "out" else len(shape) - 2
-                    other_dim = len(shape) - 2 if kind == "out" else len(shape) - 1
                     if shape[tp_dim] % tp == 0:
                         spec: list = [None] * len(shape)
+                        if kind == "vocab":
+                            # tp (and fsdp, when it also divides) stack on the
+                            # vocab dim; hidden stays replicated (see rule docs)
+                            if (
+                                shards_other
+                                and shape[tp_dim] % (tp * fsdp) == 0
+                                and math.prod(shape) >= min_size
+                            ):
+                                spec[tp_dim] = (axis_name, "fsdp")
+                            else:
+                                spec[tp_dim] = axis_name
+                            return _named_sharding(mesh, PartitionSpec(*spec), memory_kind)
+                        other_dim = len(shape) - 2 if kind == "out" else len(shape) - 1
                         spec[tp_dim] = axis_name
                         if (
                             shards_other
@@ -101,3 +117,38 @@ def make_tp_sharding_fn(
         return base(x)
 
     return rule
+
+
+def wrap_with_pp_rule(
+    rule: Callable[[Any, Any], NamedSharding],
+    mesh: Mesh,
+    axis_name: str = "pp",
+) -> Callable[[Any, Any], NamedSharding]:
+    """Compose a pipeline-stage rule over an existing ``(path, leaf)`` rule.
+
+    Scan-stacked layer params (paths under ``layers/``, leading dim = depth)
+    shard their depth axis over ``pp`` so each pipeline stage *owns* its layer
+    slice at rest — without this, ``pipeline_apply``'s shard_map reshards the
+    fsdp-sharded stack onto the pp axis every step (an SPMD full-remat).
+    Trailing-dim assignments (tp/fsdp) from the inner rule are kept; in the
+    rare case the inner rule claimed dim 0, pp wins (stage locality beats
+    intra-stack fsdp for that leaf).
+    """
+    pp = mesh_lib.mesh_axis_size(mesh, axis_name)
+    if pp <= 1:
+        return rule
+
+    def pp_rule(path, x) -> NamedSharding:
+        inner = rule(path, x)
+        shape = getattr(x, "shape", ())
+        p = path_to_str(path)
+        if "layers/" not in p or not shape or shape[0] % pp != 0:
+            return inner
+        spec = list(inner.spec) + [None] * (len(shape) - len(inner.spec))
+        spec[0] = axis_name
+        kwargs = {}
+        if getattr(inner, "memory_kind", None) is not None:
+            kwargs["memory_kind"] = inner.memory_kind
+        return NamedSharding(mesh, PartitionSpec(*spec), **kwargs)
+
+    return pp_rule

@@ -126,3 +126,89 @@ class TestPreparePipeline:
         fn = prepare_pipeline(model, params, mesh=make_mesh(4), num_microbatches=4, jit=False)
         with pytest.raises(ValueError, match="microbatches"):
             fn(params, ids)
+
+
+class TestTrainerIntegration:
+    """ModelParallelPlugin(pp_degree>1) wired through compile_train_step:
+    pp must train (loss == dp-only run), never silently replicate."""
+
+    def _train(self, mesh_axes, model, params, loss_fn, batch, mp=None, fsdp=None, steps=2):
+        import optax
+
+        import accelerate_tpu as at
+
+        at.AcceleratorState._reset_state(reset_partial_state=True)
+        at.GradientState._reset_state()
+        acc = at.Accelerator(
+            mixed_precision="bf16", megatron_lm_plugin=mp, fsdp_plugin=fsdp, mesh=mesh_axes
+        )
+        state = acc.create_train_state(params=params, tx=optax.adamw(1e-3), seed=0)
+        step = acc.compile_train_step(loss_fn, max_grad_norm=1.0, donate=False)
+        losses = []
+        for _ in range(steps):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    def test_pp_train_step_matches_dp_only(self):
+        import accelerate_tpu as at
+        from accelerate_tpu.models.transformer import lm_loss_fn
+        from accelerate_tpu.parallel import pipeline_lm_loss_fn
+
+        cfg = TransformerConfig.tiny(scan_layers=True)
+        model = Transformer(cfg)
+        ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+        batch = {"input_ids": jnp.asarray(ids)}
+
+        _, ref = self._train({"dp": 8}, model, params, lm_loss_fn(model), batch)
+        # deliberately built BEFORE the pp Accelerator exists: the mesh must
+        # resolve lazily at compile time, not bind the dp-only mesh above
+        pp_loss = pipeline_lm_loss_fn(model, num_microbatches=2)
+        state_pp, pp = self._train(
+            {"dp": 2, "fsdp": 2, "pp": 2},
+            model, params,
+            pp_loss,
+            batch,
+            mp=at.ModelParallelPlugin(pp_degree=2, num_micro_batches=2),
+            fsdp=at.FullyShardedDataParallelPlugin(min_weight_size=1024),
+        )
+        np.testing.assert_allclose(ref, pp, rtol=2e-2)
+        # no silent replication: stacked layer params shard their depth over pp
+        specs = {str(s.sharding.spec) for s in jax.tree_util.tree_leaves(state_pp.params)}
+        assert any("'pp'" in s for s in specs), specs
+        # ...and the schedule really pipelines: the lowered loss contains the
+        # ppermute activation rotation (loss parity alone cannot detect silent
+        # replication — a replicated run computes the same numbers)
+        hlo = jax.jit(pp_loss).lower(params, batch).as_text()
+        assert "collective_permute" in hlo, "pp loss lowered without ppermute"
+
+    def test_non_pp_aware_loss_rejected(self):
+        import accelerate_tpu as at
+        from accelerate_tpu.models.transformer import lm_loss_fn
+
+        cfg = TransformerConfig.tiny()
+        model = Transformer(cfg)
+        at.AcceleratorState._reset_state(reset_partial_state=True)
+        at.GradientState._reset_state()
+        acc = at.Accelerator(
+            megatron_lm_plugin=at.ModelParallelPlugin(pp_degree=2), mesh={"dp": 4, "pp": 2}
+        )
+        with pytest.raises(ValueError, match="pp axis"):
+            acc.compile_train_step(lm_loss_fn(model))
+        at.AcceleratorState._reset_state(reset_partial_state=True)
+        at.GradientState._reset_state()
+
+    def test_microbatch_not_divisible_by_data_axes_raises(self):
+        layers = make_layers(4, 8)
+        mesh = build_mesh({"dp": 4, "pp": 2})
+        mbs = jnp.ones((4, 2, 8))  # mb size 2 does not divide dp=4
+        with pytest.raises(ValueError, match="data axes"):
+            pipeline_apply(simple_stage_fn, layers, mbs, mesh=mesh)
+
+    def test_moe_config_rejected(self):
+        from accelerate_tpu.parallel import pipeline_lm_loss_fn
+
+        cfg = TransformerConfig.tiny_moe()
+        with pytest.raises(NotImplementedError, match="MoE"):
+            pipeline_lm_loss_fn(Transformer(cfg), mesh=make_mesh(2))

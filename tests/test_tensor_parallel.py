@@ -59,7 +59,10 @@ class TestPlacement:
         assert specs["layers_0/attn/o_proj/kernel"] == ("tp", "fsdp")  # row
         assert specs["layers_0/mlp/gate_proj/kernel"] == ("fsdp", "tp")
         assert specs["layers_0/mlp/down_proj/kernel"] == ("tp", "fsdp")
-        assert specs["embed_tokens/embedding"] == ("tp", "fsdp")  # vocab-parallel
+        # vocab-parallel: tp AND fsdp stack on the vocab dim, hidden replicated
+        # (fsdp on hidden forces a full-remat reshard in the embedding-grad
+        # scatter; see DEFAULT_TP_RULES)
+        assert specs["embed_tokens/embedding"] == (("tp", "fsdp"),)
         assert specs["lm_head/kernel"] == ("fsdp", "tp")
 
     def test_opt_state_mirrors_params(self, model_and_batch):
