@@ -51,6 +51,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
+from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .parallel import mesh as mesh_lib
 from .parallel.sharding import make_opt_sharding_fn, make_param_sharding_fn
@@ -75,6 +76,9 @@ from .utils.dataclasses import (
     ZeroPlugin,
     parse_flag_from_env,
 )
+
+logger = get_logger(__name__)
+
 
 def _strip_memory_kind(s):
     if isinstance(s, NamedSharding) and s.memory_kind not in (None, "device"):
@@ -554,9 +558,10 @@ class Accelerator:
             from .utils.dataclasses import TENSOR_DTYPES
 
             grad_accum_dtype = TENSOR_DTYPES[self.collective_handler.grad_reduce_dtype]
+        powersgd = self._powersgd_config()
 
         def init_fn(p):
-            return TrainState.create(
+            ts = TrainState.create(
                 apply_fn=apply_fn,
                 params=p,
                 tx=tx,
@@ -575,6 +580,19 @@ class Accelerator:
                 rng=rng,
                 grad_accum_dtype=grad_accum_dtype,
             )
+            if powersgd is not None:
+                from .parallel.compression import powersgd_init
+
+                ts = ts.replace(
+                    comm_state=powersgd_init(
+                        p,
+                        rank=powersgd["rank"],
+                        min_compression_size=powersgd["min_size"],
+                        key=jax.random.PRNGKey(0),
+                        replicas=mesh_lib.mesh_axis_size(self.mesh, "dp"),
+                    )
+                )
+            return ts
 
         abstract = jax.eval_shape(init_fn, params)
         shardings = self._train_state_shardings(abstract)
@@ -657,6 +675,14 @@ class Accelerator:
                 # grads are touched every micro-step: keep them in HBM even when
                 # the optimizer state is host-offloaded
                 return _strip_memory_kind(grad_rule(path, x))
+            if name == "comm_state":
+                # PowerSGD state: error feedback is per-replica (leading axis
+                # over dp); warm-start q is replicated (parallel/compression.py)
+                last = path[-1]
+                key_name = getattr(last, "key", getattr(last, "name", None))
+                if key_name == "error" and mesh_lib.mesh_axis_size(self.mesh, "dp") > 1:
+                    return NamedSharding(self.mesh, PartitionSpec("dp"))
+                return replicated
             return replicated
 
         return jax.tree_util.tree_map_with_path(rule, abstract_state)
@@ -686,6 +712,42 @@ class Accelerator:
                 shardings,
             )
         return placed
+
+    def _powersgd_config(self) -> Optional[Dict[str, int]]:
+        """Validated PowerSGD settings, or None when the hook is off.
+
+        The hook runs the backward per-replica under ``shard_map`` over ``dp``
+        (reference ``DDPCommunicationHookType.POWER_SGD`` analog); composing
+        that with sharded-parameter axes would need partial-auto shard_map over
+        every rule in ``parallel/``, so it is restricted to pure-dp meshes —
+        the multi-slice DDP topology the hook exists for.
+        """
+        handler = self.collective_handler
+        if handler is None or handler.comm_hook in (None, "none"):
+            return None
+        if handler.comm_hook != "powersgd":
+            raise ValueError(
+                f"Unknown CollectiveKwargs.comm_hook {handler.comm_hook!r}; "
+                "supported: 'none', 'powersgd'."
+            )
+        offending = [
+            a for a in self.mesh.axis_names
+            if a != "dp" and mesh_lib.mesh_axis_size(self.mesh, a) > 1
+        ]
+        if offending:
+            raise ValueError(
+                "comm_hook='powersgd' compresses the dp gradient reduction and "
+                f"requires a pure-dp mesh; this mesh also shards over {offending}. "
+                "Drop the hook or the extra axes (FSDP/TP already shard gradient "
+                "traffic; PowerSGD targets replicated-DP over slow networks)."
+            )
+        if self._use_loss_scaling:
+            raise ValueError(
+                "comm_hook='powersgd' is bf16/fp32-only: dynamic loss scaling "
+                "re-scales gradients across steps, which breaks the error-feedback "
+                "carry (stale-scale residuals)."
+            )
+        return {"rank": int(handler.powersgd_rank), "min_size": int(handler.comm_hook_min_size)}
 
     # ------------------------------------------------------------- step build
     def _offload_flags(self, warn: bool = False):
@@ -830,6 +892,72 @@ class Accelerator:
         if offload_opt or offload_params:
             donate = False  # donation of host-resident buffers is rejected by XLA
 
+        powersgd = self._powersgd_config()
+        mesh = self.mesh
+        dp_present = mesh_lib.mesh_axis_size(mesh, "dp") > 1
+
+        def _powersgd_grads(params, batch, sub, comm_state):
+            """Per-replica backward + compressed mean over dp (parallel/compression.py).
+
+            comm_state entries carry the error buffer with a leading replica
+            axis sharded over dp; each shard_map block sees its own slice.
+            """
+            from .parallel.compression import compressed_pmean
+
+            p_leaves, p_def = jax.tree_util.tree_flatten(params)
+            entries = p_def.flatten_up_to(comm_state)
+
+            def entry_specs():
+                def one(e):
+                    if e is None:
+                        return None
+                    err = PartitionSpec("dp") if dp_present else PartitionSpec()
+                    return {"q": PartitionSpec(), "error": err}
+                return jax.tree_util.tree_unflatten(p_def, [one(e) for e in entries])
+
+            def run(params, batch, sub, comm_state):
+                if sub is not None:
+                    # distinct dropout per replica (the SPMD path's global mask
+                    # sharded over dp has per-example randomness; match it)
+                    sub = jax.random.fold_in(sub, jax.lax.axis_index("dp"))
+                local_entries = [
+                    e if e is None else {"q": e["q"], "error": e["error"][0] if dp_present else e["error"]}
+                    for e in p_def.flatten_up_to(comm_state)
+                ]
+                local_state = jax.tree_util.tree_unflatten(p_def, local_entries)
+
+                def loss_and_aux(p):
+                    loss, aux = wrapped_loss(p, batch, sub)
+                    return loss, (loss, aux)
+
+                grads, (loss, aux) = jax.grad(loss_and_aux, has_aux=True)(params)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+                ghat, new_local = compressed_pmean(grads, local_state, "dp")
+                ghat = jax.tree_util.tree_map(lambda g: g.astype(reduce_dtype), ghat)
+                new_entries = [
+                    e if e is None else {"q": e["q"], "error": e["error"][None] if dp_present else e["error"]}
+                    for e in p_def.flatten_up_to(new_local)
+                ]
+                new_comm = jax.tree_util.tree_unflatten(p_def, new_entries)
+                loss = jax.lax.pmean(loss, "dp")
+                aux = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "dp"), aux)
+                return ghat, loss, aux, new_comm
+
+            # mirror _constrain_batch: only leaves with a batch dim shard over
+            # dp; scalars/rank-0 leaves replicate
+            data_spec = jax.tree_util.tree_map(
+                lambda x: PartitionSpec("dp") if getattr(x, "ndim", 0) >= 1 else PartitionSpec(),
+                batch,
+            )
+            rng_spec = None if sub is None else PartitionSpec()
+            return jax.shard_map(
+                run,
+                mesh=mesh,
+                in_specs=(PartitionSpec(), data_spec, rng_spec, entry_specs()),
+                out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec(), entry_specs()),
+                check_vma=False,
+            )(params, batch, sub, comm_state)
+
         def _step(state: TrainState, batch, force_sync):
             from jax.memory import Space
 
@@ -847,14 +975,20 @@ class Accelerator:
 
             scale = state.loss_scale.scale if fp16 else jnp.float32(1.0)
 
-            def scaled_loss(p):
-                loss, aux = wrapped_loss(p, batch, sub)
-                return loss * scale, (loss, aux)
+            new_comm = state.comm_state
+            if powersgd is not None:
+                grads, loss, aux, new_comm = _powersgd_grads(
+                    state.params, batch, sub, state.comm_state
+                )
+            else:
+                def scaled_loss(p):
+                    loss, aux = wrapped_loss(p, batch, sub)
+                    return loss * scale, (loss, aux)
 
-            grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(state.params)
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / scale).astype(reduce_dtype), grads
-            )
+                grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(state.params)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) / scale).astype(reduce_dtype), grads
+                )
 
             count = state.micro_step + 1
             if accum > 1:
@@ -900,7 +1034,7 @@ class Accelerator:
                 )
                 new_state = new_state.replace(grad_accum=new_accum)
             new_state = new_state.replace(
-                micro_step=jnp.where(do_sync, 0, count), rng=new_rng
+                micro_step=jnp.where(do_sync, 0, count), rng=new_rng, comm_state=new_comm
             )
             if fp16:
                 new_scale = jax.lax.cond(
@@ -1126,31 +1260,30 @@ class Accelerator:
 
     def gather_for_metrics(self, input_data, use_gather_object: bool = False):
         """Gather + drop end-of-epoch duplicate samples (reference ``accelerator.py:2352-2417``)."""
-        try:
-            recursively_apply = ops.recursively_apply  # probe tensor-ness
-            all_tensors = True
-            for leaf in jax.tree_util.tree_leaves(input_data):
-                if not ops.is_tensor(leaf):
-                    all_tensors = False
-                    break
-        except Exception:
-            all_tensors = False
+        all_tensors = all(ops.is_tensor(leaf) for leaf in jax.tree_util.tree_leaves(input_data))
         if not all_tensors or use_gather_object:
             data = ops.gather_object(input_data)
         else:
             data = ops.gather(input_data)
-        try:
-            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
-                def _adjust(tensor):
-                    return tensor[: self.gradient_state.remainder]
+        if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+            def _adjust(tensor):
+                return tensor[: self.gradient_state.remainder]
 
-                if all_tensors and not use_gather_object:
-                    data = ops.recursively_apply(_adjust, data)
-                else:
+            if all_tensors and not use_gather_object:
+                data = ops.recursively_apply(_adjust, data)
+            else:
+                try:
                     data = data[: self.gradient_state.remainder]
-            return data
-        except Exception:
-            return data
+                except TypeError:
+                    # Gathered python objects that don't support slicing (e.g. a
+                    # dict) can't be truncated; return them whole rather than
+                    # fail the metrics path.  Any other error is a real bug and
+                    # propagates.
+                    logger.warning_once(
+                        "gather_for_metrics could not truncate duplicate end-of-epoch "
+                        "samples on a non-sliceable object; returning data unmodified."
+                    )
+        return data
 
     def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
         return ops.reduce(tensor, reduction=reduction, scale=scale)

@@ -56,6 +56,10 @@ def load_config_from_file(config_file: Optional[str] = None) -> "ClusterConfig":
 class ComputeEnvironment(str, Enum):
     LOCAL_MACHINE = "LOCAL_MACHINE"
     TPU_POD = "TPU_POD"
+    # Recognized so reference configs parse, but launching is refused with a
+    # clear error (commands/launch.py): SageMaker is a CUDA-cloud API boundary
+    # (reference commands/launch.py:886) with no TPU backend to target.
+    AMAZON_SAGEMAKER = "AMAZON_SAGEMAKER"
 
 
 @dataclass

@@ -23,6 +23,7 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.environment import set_default_thread_env
 from .config.config_args import ClusterConfig, load_config_from_file, parse_mesh_spec
 
 description = "Launch a script on one or several hosts of a TPU pod (or CPU, for tests)."
@@ -55,6 +56,9 @@ def launch_command_parser(subparsers=None):
                          "(torchelastic max_restarts analog; supervision is first-party).")
     hw.add_argument("--monitor_interval", type=float, default=1.0,
                     help="Seconds between worker liveness polls in multi-process mode.")
+    hw.add_argument("--numa_affinity", action="store_true",
+                    help="Pin each local process to one NUMA node's cores "
+                         "(reference set_numa_affinity analog).")
     # training config
     tr = parser.add_argument_group("Training")
     tr.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16"])
@@ -153,11 +157,18 @@ def _merge_with_config(args) -> ClusterConfig:
     return config
 
 
-def prepare_launch_env(config: ClusterConfig) -> Dict[str, str]:
+def prepare_launch_env(
+    config: ClusterConfig, local_world_size: int = 1, numa_pinned: bool = False
+) -> Dict[str, str]:
     """Serialize config → ``ACCELERATE_*`` env vars, the cross-process config IPC
     (reference ``utils/launch.py:152-273``).  Keys match what ``PartialState``
     (``state.py:45-47``) and the plugin dataclasses rehydrate from."""
     env: Dict[str, str] = {}
+    # Host-thread budget (reference state.py:238-253): an even core split per
+    # local process (and per NUMA node when pinning), unless the user chose.
+    set_default_thread_env(env, local_world_size, numa_pinned)
+    if numa_pinned:
+        env["ACCELERATE_USE_NUMA_AFFINITY"] = "true"
     env["ACCELERATE_MIXED_PRECISION"] = config.mixed_precision
     if config.debug:
         env["ACCELERATE_DEBUG_MODE"] = "true"
@@ -258,7 +269,7 @@ def simple_launcher(args, config: ClusterConfig) -> int:
             "cannot rejoin the jax.distributed rendezvous. Use your cluster "
             "scheduler's restart policy for multi-host elasticity."
         )
-    launch_env = prepare_launch_env(config)
+    launch_env = prepare_launch_env(config, numa_pinned=args.numa_affinity)
     if config.use_cpu:
         _apply_cpu_device_count(launch_env, args.num_cpu_devices)
     elif args.num_cpu_devices:
@@ -285,7 +296,9 @@ def multi_process_cpu_launcher(args, config: ClusterConfig, num_processes: int) 
     import socket
     import time
 
-    base_env = prepare_launch_env(config)
+    base_env = prepare_launch_env(
+        config, local_world_size=num_processes, numa_pinned=args.numa_affinity
+    )
     base_env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
     base_env["JAX_PLATFORMS"] = "cpu"
     _apply_cpu_device_count(base_env, args.num_cpu_devices)
@@ -329,7 +342,26 @@ def multi_process_cpu_launcher(args, config: ClusterConfig, num_processes: int) 
 
 
 def launch_command(args) -> None:
+    from .config.config_args import ComputeEnvironment
+
     config = _merge_with_config(args)
+    if config.compute_environment == ComputeEnvironment.AMAZON_SAGEMAKER.value:
+        # Reference dispatches to the SageMaker Python SDK (commands/launch.py:886),
+        # a CUDA-cloud API with no TPU offering behind it.  Refuse loudly rather
+        # than silently running locally with the wrong topology.
+        raise ValueError(
+            "compute_environment AMAZON_SAGEMAKER is out of scope for the TPU "
+            "build: SageMaker provisions CUDA instances via the AWS SDK and has "
+            "no TPU backend. Run on a TPU VM/pod (compute_environment TPU_POD "
+            "with --num_machines/--machine_rank), or use the reference "
+            "framework for SageMaker jobs."
+        )
+    valid_envs = {e.value for e in ComputeEnvironment}
+    if config.compute_environment not in valid_envs:
+        raise ValueError(
+            f"Unknown compute_environment {config.compute_environment!r}; "
+            f"valid values: {sorted(valid_envs)}."
+        )
     if config.use_cpu and args.num_processes and args.num_processes > 1:
         rc = multi_process_cpu_launcher(args, config, args.num_processes)
     else:

@@ -428,6 +428,18 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100, z_loss: float =
     return nll.sum() / jnp.maximum(mask.sum(), 1)
 
 
+def shift_labels(batch) -> jax.Array:
+    """Next-token labels for a causal LM batch: ``batch["labels"]`` if given,
+    else ``input_ids`` shifted left with ``-100`` (ignore) at the final
+    position.  Single source of the shift/ignore convention for both the
+    monolithic (``lm_loss_fn``) and pipeline (``pipeline_lm_loss_fn``) paths —
+    their parity checks rely on it being identical."""
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    return labels
+
+
 def lm_loss_fn(model: Transformer):
     """Standard next-token loss for ``Accelerator.compile_train_step``.
 
@@ -445,9 +457,7 @@ def lm_loss_fn(model: Transformer):
             )
         else:
             logits = model.apply({"params": params}, batch["input_ids"])
-        labels = batch.get("labels")
-        if labels is None:
-            labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+        labels = shift_labels(batch)
         loss = cross_entropy_loss(logits, labels)
         if is_moe:
             from ..parallel.moe import router_aux_loss

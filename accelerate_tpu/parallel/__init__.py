@@ -1,5 +1,6 @@
 """Parallelism substrate: named meshes, sharding rules, collectives, model parallel."""
 
+from .compression import compressed_pmean, compression_stats, powersgd_init
 from .moe import MoEMLP, router_aux_loss, shard_moe_params, top_k_dispatch
 from .pipeline import pipeline_apply, pipeline_lm_loss_fn, prepare_pipeline, stack_layer_params
 from .ring_attention import (

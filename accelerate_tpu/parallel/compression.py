@@ -1,0 +1,167 @@
+"""PowerSGD gradient compression — the low-rank DDP comm-hook analog.
+
+Reference surface: ``DDPCommunicationHookType.POWER_SGD`` wired through
+``DistributedDataParallelKwargs`` (reference ``utils/dataclasses.py:105-199``),
+where torch's reducer all-reduces rank-``r`` factors instead of full gradients.
+
+TPU-native design.  GSPMD inserts gradient reductions implicitly, so there is
+no "hook point" to intercept — instead the replica dimension is made explicit:
+the train step's backward runs inside ``jax.shard_map`` over the ``dp`` axis,
+each replica computes gradients for its local batch shard, and the cross-replica
+mean is performed on the PowerSGD factors (Vogels et al., NeurIPS 2019):
+
+    M      = local grad reshaped to (m, n), plus the replica's error feedback
+    P      = pmean(M @ Q)            # (m, r) — r·m floats on the wire
+    P      = orthonormalize(P)       # thin QR
+    Q'     = pmean(Mᵀ @ P)           # (n, r) — r·n floats on the wire
+    Ĝ      = P @ Q'ᵀ                 # rank-r approximation, identical on all replicas
+    error  = M - Ĝ                   # stays local (error feedback)
+
+Per step this moves ``r·(m+n)`` floats per matrix instead of ``m·n`` — the
+bandwidth win that matters when the ``dp`` axis rides DCN (multi-slice meshes),
+where gradient reduction is the slow-network bottleneck the reference's
+PowerSGD hook exists for.  ``Q`` is warm-started across steps (the paper's
+power-iteration reuse); error feedback makes the compression unbiased over
+time.  Rank ``r >= min(m, n)`` reproduces the exact mean gradient (projection
+onto the full column space), which the tests use as the parity oracle.
+
+Leaves too small to benefit (``size < min_compression_size``) and 1-D leaves
+(biases, norms) are reduced uncompressed, matching the reference hook's
+``min_compression_rate`` behavior.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _matrix_shape(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    """(m, n) view of a leaf: trailing dim stays, leading dims merge — keeps
+    transformer weights ((d, ff), stacked (L, d, ff)) well-conditioned 2-D."""
+    n = shape[-1]
+    m = math.prod(shape[:-1])
+    return m, n
+
+
+def is_compressible(shape: Tuple[int, ...], rank: int, min_size: int) -> bool:
+    """2-D-able leaves at least ``min_size`` elements compress; 1-D leaves
+    (biases, norms) never do.  Whether rank ``r`` actually shrinks the wire
+    format (``r·(m+n) < m·n``) is the user's rank choice — full rank is legal
+    (it reproduces the exact mean; the tests' parity oracle) and
+    ``compression_stats`` reports the achieved ratio."""
+    if len(shape) < 2:
+        return False
+    m, n = _matrix_shape(shape)
+    return m * n >= min_size
+
+
+def powersgd_init(
+    params: Any,
+    *,
+    rank: int = 4,
+    min_compression_size: int = 4096,
+    key: Optional[jax.Array] = None,
+    replicas: int = 1,
+) -> Any:
+    """Per-leaf compression state: warm-start ``q`` and the error-feedback
+    buffer, or ``None`` for leaves reduced uncompressed.
+
+    The returned tree is a pytree parallel to ``params`` (each compressible
+    leaf maps to ``{"q": (n, r), "error": (m, n)}``) and lives inside
+    ``TrainState.comm_state`` so it checkpoints/restores with the rest of the
+    training state.  With ``replicas > 1`` the error buffer gains a leading
+    replica axis ``(replicas, m, n)`` — error feedback is per-replica state,
+    sharded over ``dp`` by the trainer while ``q`` stays replicated.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(key, max(len(leaves), 1))
+
+    def init_leaf(p, k):
+        shape = tuple(p.shape)
+        if not is_compressible(shape, rank, min_compression_size):
+            return None
+        m, n = _matrix_shape(shape)
+        r = min(rank, m, n)
+        err_shape = (replicas, m, n) if replicas > 1 else (m, n)
+        return {
+            "q": jax.random.normal(k, (n, r), dtype=jnp.float32),
+            "error": jnp.zeros(err_shape, dtype=jnp.float32),
+        }
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [init_leaf(p, k) for p, k in zip(leaves, keys)]
+    )
+
+
+def _orthonormalize(p: jax.Array) -> jax.Array:
+    # thin QR on (m, r), r small — cheap and stable vs Gram-Schmidt
+    q, _ = jnp.linalg.qr(p.astype(jnp.float32))
+    return q
+
+
+def compressed_pmean(
+    grads: Any,
+    comm_state: Any,
+    axis_name: str,
+) -> Tuple[Any, Any]:
+    """Mean-reduce a gradient pytree across ``axis_name`` inside ``shard_map``,
+    sending rank-r factors for compressible leaves and the raw values otherwise.
+
+    Returns ``(reduced_grads, new_comm_state)``; the reduced gradients are
+    bit-identical across replicas (both factor reductions are collectives), the
+    new state is per-replica (error feedback stays local).
+    """
+
+    def reduce_leaf(g, st):
+        if st is None:
+            return jax.lax.pmean(g, axis_name), None
+        shape = tuple(g.shape)
+        m, n = _matrix_shape(shape)
+        mat = g.reshape(m, n).astype(jnp.float32) + st["error"]
+        p = jax.lax.pmean(mat @ st["q"], axis_name)
+        p = _orthonormalize(p)
+        q_new = jax.lax.pmean(mat.T @ p, axis_name)
+        approx = p @ q_new.T
+        return approx.reshape(shape).astype(g.dtype), {
+            "q": q_new,
+            "error": mat - approx,
+        }
+
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_s = treedef.flatten_up_to(comm_state)
+    out = [reduce_leaf(g, s) for g, s in zip(flat_g, flat_s)]
+    new_grads = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_state = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return new_grads, new_state
+
+
+def compression_stats(params: Any, comm_state: Any) -> Dict[str, float]:
+    """Wire-format accounting: floats sent per step with vs without compression."""
+    full = 0
+    compressed = 0
+    for p, st in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_flatten(comm_state, is_leaf=lambda x: x is None or "q" in x)[0]
+        if comm_state is not None
+        else [None] * len(jax.tree_util.tree_leaves(params)),
+    ):
+        size = int(np.prod(p.shape))
+        full += size
+        if st is None:
+            compressed += size
+        else:
+            n, r = st["q"].shape
+            m = size // n
+            compressed += r * (m + n)
+    return {
+        "floats_uncompressed": float(full),
+        "floats_compressed": float(compressed),
+        "compression_ratio": float(full) / max(float(compressed), 1.0),
+    }

@@ -159,13 +159,10 @@ def pipeline_lm_loss_fn(
     )
 
     def loss_fn(params, batch, rng=None):
+        from ..models.transformer import shift_labels
+
         logits = forward(params, batch["input_ids"])
-        labels = batch.get("labels")
-        if labels is None:
-            labels = jnp.pad(
-                batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100
-            )
-        return cross_entropy_loss(logits, labels)
+        return cross_entropy_loss(logits, shift_labels(batch))
 
     loss_fn._pp_aware = True
     return loss_fn

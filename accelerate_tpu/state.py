@@ -75,6 +75,13 @@ class PartialState:
         self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
         if cpu:
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # Opt-in NUMA pinning (reference utils/environment.py:286-291) — must
+        # run BEFORE any jax.* call below: sched_setaffinity only covers
+        # threads created after it, and backend init spawns the PJRT
+        # client/transfer thread pools that matter most.
+        from .utils.environment import override_numa_affinity
+
+        override_numa_affinity(int(os.environ.get("ACCELERATE_LOCAL_PROCESS_ID", "0")))
         # Multi-host rendezvous (reference: init_process_group, state.py:212,255).
         # NOTE: the guard must NOT call jax.process_count() — that initializes
         # the XLA backend, after which jax.distributed.initialize refuses to

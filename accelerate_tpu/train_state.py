@@ -77,6 +77,9 @@ class TrainState(struct.PyTreeNode):
     rng: Optional[jax.Array]
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # gradient-compression carry (PowerSGD warm-start Q + error feedback per
+    # leaf; parallel/compression.py) — None unless a comm hook is active
+    comm_state: Any = None
 
     @classmethod
     def create(

@@ -174,16 +174,27 @@ class KwargsHandler:
 class CollectiveKwargs(KwargsHandler):
     """Analog of ``DistributedDataParallelKwargs`` (``utils/dataclasses.py:126``).
 
-    On TPU there is no DDP reducer; the surviving tunable is the gradient
-    *carry* dtype (the comm-hook fp16/bf16 compression analog): grads are cast
-    to it right after backward, so the accumulation buffer and cross-step
-    traffic halve under bf16.  The in-step cross-replica reduction itself runs
-    in the compute dtype (XLA reduces the bf16 dot-transpose partials under a
-    bf16 policy).  Only meaningful with gradient_accumulation_steps > 1.
+    On TPU there is no DDP reducer; the surviving tunables are:
+
+    - ``grad_reduce_dtype`` — gradient *carry* dtype (the comm-hook fp16/bf16
+      compression analog): grads are cast to it right after backward, so the
+      accumulation buffer and cross-step traffic halve under bf16.  The in-step
+      cross-replica reduction itself runs in the compute dtype (XLA reduces the
+      bf16 dot-transpose partials under a bf16 policy).  Only meaningful with
+      gradient_accumulation_steps > 1.
+    - ``comm_hook="powersgd"`` — low-rank gradient compression over the ``dp``
+      axis (reference ``DDPCommunicationHookType.POWER_SGD``,
+      ``utils/dataclasses.py:105-199``): the backward runs per-replica under
+      ``shard_map`` and only rank-``powersgd_rank`` factors ride the network,
+      with per-replica error feedback (``parallel/compression.py``).  Built for
+      meshes whose ``dp`` axis crosses DCN; requires a pure-dp mesh.
     """
 
     grad_reduce_dtype: Optional[str] = None  # "bf16" | "fp16" | "fp32" | None (= fp32 carry)
     bucket_cap_mb: int = 25                  # accepted for API parity; XLA handles bucketing
+    comm_hook: str = "none"                  # "none" | "powersgd"
+    powersgd_rank: int = 4                   # factor rank r; wire cost r*(m+n) vs m*n
+    comm_hook_min_size: int = 4096           # leaves below this reduce uncompressed
 
 
 @dataclass

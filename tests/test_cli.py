@@ -301,3 +301,18 @@ class TestEnvMeshPluginValidation:
         monkeypatch.setenv("ACCELERATE_MESH", "dp=-1")
         acc = Accelerator()
         assert dict(acc.mesh.shape) == {"dp": 8}
+
+
+class TestSageMakerRefusal:
+    """AMAZON_SAGEMAKER configs parse but refuse to launch with a clear error
+    (reference commands/launch.py:886 is a CUDA-cloud boundary; out of scope)."""
+
+    def test_sagemaker_config_refused(self, tmp_path):
+        cfg = tmp_path / "sm.yaml"
+        cfg.write_text(yaml.safe_dump({"compute_environment": "AMAZON_SAGEMAKER"}))
+        parser = launch_command_parser()
+        args = parser.parse_args(["--config_file", str(cfg), "script.py"])
+        from accelerate_tpu.commands.launch import launch_command
+
+        with pytest.raises(ValueError, match="SageMaker"):
+            launch_command(args)

@@ -191,3 +191,55 @@ class TestBinCheckpointFallback:
         np.testing.assert_allclose(
             np.asarray(params["embed"]["weight"]).reshape(-1), np.arange(12)
         )
+
+
+class TestHostTuning:
+    """Thread defaults + NUMA affinity (reference state.py:238-253,
+    utils/environment.py:220-291)."""
+
+    def test_default_thread_count_splits_cores(self):
+        from accelerate_tpu.utils.environment import default_thread_count, get_cpu_count
+
+        cores = get_cpu_count()
+        assert default_thread_count(1) == cores
+        assert default_thread_count(cores * 2) == 1
+        assert default_thread_count(2) == max(cores // 2, 1)
+
+    def test_set_default_thread_env_respects_user(self):
+        from accelerate_tpu.utils.environment import set_default_thread_env
+
+        env = {"OMP_NUM_THREADS": "3"}
+        with patch_environment():
+            os.environ.pop("MKL_NUM_THREADS", None)
+            os.environ.pop("OPENBLAS_NUM_THREADS", None)
+            set_default_thread_env(env, 1)
+        assert env["OMP_NUM_THREADS"] == "3"  # user's choice wins
+        assert "MKL_NUM_THREADS" in env and "OPENBLAS_NUM_THREADS" in env
+
+    def test_parse_cpulist(self):
+        from accelerate_tpu.utils.environment import _parse_cpulist
+
+        assert _parse_cpulist("0-3,8-9,12\n") == [0, 1, 2, 3, 8, 9, 12]
+        assert _parse_cpulist("") == []
+
+    def test_set_numa_affinity_no_crash(self):
+        # Must be a no-op (not an error) on hosts without readable topology;
+        # on NUMA hosts it pins and the affinity stays a subset of the start set.
+        from accelerate_tpu.utils.environment import get_numa_nodes, set_numa_affinity
+
+        before = os.sched_getaffinity(0)
+        try:
+            set_numa_affinity(0)
+            if get_numa_nodes():
+                assert os.sched_getaffinity(0) <= before
+        finally:
+            os.sched_setaffinity(0, before)
+
+    def test_launch_env_sets_threads(self):
+        from accelerate_tpu.commands.launch import prepare_launch_env
+        from accelerate_tpu.commands.config.config_args import ClusterConfig
+
+        with patch_environment():
+            os.environ.pop("OMP_NUM_THREADS", None)
+            env = prepare_launch_env(ClusterConfig(), local_world_size=1)
+        assert int(env["OMP_NUM_THREADS"]) >= 1
