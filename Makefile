@@ -1,10 +1,17 @@
 # Developer targets (reference Makefile:25-72 test split analog).
 
-.PHONY: test test_core test_big_modeling test_cli test_examples test_multiprocess \
-        test_kernels native bench quality
+.PHONY: test test_fast test_slow test_core test_big_modeling test_cli test_examples \
+        test_multiprocess test_kernels native bench quality
 
 test:
 	python -m pytest tests/ -q
+
+# the developer loop: everything not marked slow (< 2 min; see tests/conftest.py)
+test_fast:
+	python -m pytest tests/ -q -m "not slow"
+
+test_slow:
+	python -m pytest tests/ -q -m "slow"
 
 # split targets for CI sharding
 test_core:

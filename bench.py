@@ -139,6 +139,163 @@ def bench_lm_proxy():
     )
 
 
+def _bench_train_config(
+    metric: str,
+    cfg_kwargs: dict,
+    *,
+    batch: int,
+    accelerator_kwargs: dict,
+    baseline_note: str,
+    steps: int = STEPS,
+    warmup: int = WARMUP,
+    smoke: bool = False,
+):
+    """Shared runner for the big-geometry training benches (zero3 / fsdp).
+
+    Measures samples/s(/chip) and MFU for a Transformer of the given geometry
+    under the given Accelerator config.  ``smoke=True`` shrinks the geometry
+    so the path is CI-testable on CPU (same code, tiny shapes).
+    """
+    import optax
+
+    import accelerate_tpu as at
+    from accelerate_tpu.models.transformer import Transformer, TransformerConfig, lm_loss_fn
+
+    if smoke:
+        cfg_kwargs = {
+            **cfg_kwargs,
+            "vocab_size": 512,
+            "hidden_size": 64,
+            "intermediate_size": 128,
+            "num_layers": 2,
+            "num_heads": 4,
+            "num_kv_heads": 2,
+            "max_seq_len": 64,
+        }
+        batch, steps, warmup = 2, 2, 1
+    seq = cfg_kwargs["max_seq_len"]
+    cfg = TransformerConfig(scan_layers=True, remat=True, **cfg_kwargs)
+    model = Transformer(cfg)
+
+    acc = at.Accelerator(mixed_precision="bf16", **accelerator_kwargs)
+    n_chips = len(jax.devices())
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), ids[:1])["params"])
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # init for real (sharded/offloaded placement decided by create_train_state)
+    params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+    state = acc.create_train_state(params=params, tx=optax.adamw(1e-4), seed=0)
+    del params
+    step = acc.compile_train_step(lm_loss_fn(model), max_grad_norm=1.0)
+
+    batch_pytree = {"input_ids": ids}
+    for _ in range(warmup):
+        state, metrics = step(state, batch_pytree)
+    float(metrics["loss"])  # D2H barrier (block_until_ready unreliable on tunnels)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_pytree)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+    per_chip = samples_per_sec / n_chips
+    tflops = 6 * n_params * seq * samples_per_sec / 1e12
+    peak = detect_peak_tflops()
+    detail = {
+        "params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "chips": n_chips,
+        "step_ms": round(1e3 * dt / steps, 2),
+        "model_tflops_per_sec": round(tflops, 2),
+        "tokens_per_sec": round(samples_per_sec * seq, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "baseline": baseline_note,
+        "final_loss": float(metrics["loss"]),
+        "smoke": smoke,
+    }
+    if peak is not None:
+        detail["chip_peak_tflops"] = peak
+        detail["mfu"] = round(tflops / n_chips / peak, 4)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(per_chip, 3),
+                "unit": "samples/s/chip",
+                # no published reference throughput exists for these configs
+                # (BASELINE.md: "functional parity" / convergence targets);
+                # report MFU as the defensible number and leave vs_baseline
+                # as achieved-MFU so the field stays meaningful, labeled.
+                "vs_baseline": detail.get("mfu"),
+                "detail": detail,
+            }
+        )
+    )
+
+
+def bench_zero3(smoke: bool = False, batch: int = 4):
+    """GPT-2-XL geometry (1.5B), ZeRO-3 + host optimizer offload — the
+    BASELINE.md 'DeepSpeed ZeRO-3 plugin equivalent' config.  The fp32 adam
+    moments (~12 GB) live in host memory and stream to HBM only on update
+    steps; params stay sharded in HBM."""
+    import accelerate_tpu as at
+
+    _bench_train_config(
+        "gpt2xl_zero3_offload_samples_per_sec_per_chip",
+        dict(
+            vocab_size=50257,
+            hidden_size=1600,
+            intermediate_size=6400,
+            num_layers=48,
+            num_heads=25,
+            num_kv_heads=25,
+            max_seq_len=1024,
+        ),
+        batch=batch,
+        accelerator_kwargs=dict(
+            deepspeed_plugin=at.ZeroPlugin(zero_stage=3, offload_optimizer_device="cpu"),
+            mesh={"fsdp": -1},
+        ),
+        baseline_note="BASELINE.md: GPT-2-XL ZeRO-3 + host offload — functional parity target; vs_baseline reports MFU",
+        smoke=smoke,
+    )
+
+
+def bench_fsdp(smoke: bool = False, batch: int = 4):
+    """Llama geometry full-shard FSDP at the largest single-chip-feasible
+    scale (TinyLlama-1.1B-class: hidden 2048, GQA 32/4, SwiGLU 5632, seq 2048,
+    16 layers ≈ 0.84B so fp32 params+grads+adam ≈ 13.5 GB fit v5e HBM) — the
+    BASELINE.md 'Llama-2-7B full-shard FSDP' config scaled to the bench rig;
+    on a pod mesh the same code spans chips."""
+    import accelerate_tpu as at
+
+    _bench_train_config(
+        "llama_fsdp_full_shard_samples_per_sec_per_chip",
+        dict(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_layers=16,
+            num_heads=32,
+            num_kv_heads=4,
+            max_seq_len=2048,
+        ),
+        batch=batch,
+        accelerator_kwargs=dict(
+            fsdp_plugin=at.FullyShardedDataParallelPlugin(sharding_strategy="FULL_SHARD"),
+            mesh={"fsdp": -1},
+        ),
+        baseline_note="BASELINE.md: Llama full-shard FSDP MFU target; vs_baseline reports MFU",
+        smoke=smoke,
+    )
+
+
 def bench_mrpc(epochs: int = 3):
     """Time the real examples/nlp_example.py task (text-pair classification on
     the checked-in dataset) — the literal BASELINE.md workload."""
@@ -193,10 +350,17 @@ def bench_mrpc(epochs: int = 3):
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--task", choices=["lm", "mrpc"], default="lm")
+    parser.add_argument("--task", choices=["lm", "mrpc", "zero3", "fsdp"], default="lm")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny-geometry run of the same code path (CI)")
+    parser.add_argument("--batch", type=int, default=None)
     args = parser.parse_args()
     if args.task == "mrpc":
         bench_mrpc()
+    elif args.task == "zero3":
+        bench_zero3(smoke=args.smoke, **({"batch": args.batch} if args.batch else {}))
+    elif args.task == "fsdp":
+        bench_fsdp(smoke=args.smoke, **({"batch": args.batch} if args.batch else {}))
     else:
         bench_lm_proxy()
 

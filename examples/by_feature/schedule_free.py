@@ -1,0 +1,86 @@
+"""Feature: schedule-free optimization (reference
+`examples/by_feature/schedule_free.py`, which uses facebookresearch's
+schedulefree AdamW).
+
+Schedule-free methods (Defazio et al., 2024) replace the LR schedule with an
+interpolation of iterate averaging: no warmup/decay horizon needs choosing.
+The optax implementation is `optax.contrib.schedule_free_adamw`; the one
+usage wrinkle is that the *training* params are not the *evaluation* params —
+you must evaluate at `schedule_free_eval_params(opt_state, params)`, exactly
+like the reference calls `optimizer.eval()` mode.
+
+Run:  python examples/by_feature/schedule_free.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, set_seed
+from nlp_example import MAX_LEN, EncoderClassifier, get_dataloaders
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--warmup_steps", type=int, default=50)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(mesh={"dp": -1})
+    set_seed(42)
+    train_dl, eval_dl = get_dataloaders(accelerator, batch_size=16)
+
+    model = EncoderClassifier()
+    params = model.init(jax.random.PRNGKey(42), jnp.zeros((1, MAX_LEN), jnp.int32))["params"]
+
+    # the schedule-free transform: no decay schedule anywhere
+    tx = optax.contrib.schedule_free_adamw(
+        learning_rate=args.lr, warmup_steps=args.warmup_steps, b1=0.9
+    )
+    state = accelerator.create_train_state(params=params, tx=tx, seed=42)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["input_ids"])
+        return optax.softmax_cross_entropy(logits, jax.nn.one_hot(batch["labels"], 2)).mean()
+
+    step = accelerator.compile_train_step(loss_fn, max_grad_norm=1.0)
+
+    def eval_logits(p, batch):
+        return model.apply({"params": p}, batch["input_ids"])
+
+    eval_step = accelerator.compile_eval_step(eval_logits)
+
+    @jax.jit
+    def eval_params_of(state):
+        # train params (y_t) -> evaluation params (x_t): the schedule-free
+        # averaging lives in the optimizer state
+        return optax.contrib.schedule_free_eval_params(state.opt_state, state.params)
+
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+
+        eval_state = state.replace(params=eval_params_of(state))
+        correct = total = 0
+        for batch in eval_dl:
+            logits = eval_step(eval_state, batch)
+            preds = accelerator.gather_for_metrics(logits).argmax(-1)
+            labels = accelerator.gather_for_metrics(batch["labels"])
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += int(np.asarray(labels).shape[0])
+        accelerator.print(
+            f"epoch {epoch}: loss={float(metrics['loss']):.4f} "
+            f"eval_acc(schedule-free params)={correct / total:.3f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Distributed batch generation (reference
+`examples/inference/distributed/phi2.py`): split a prompt list across
+processes with `PartialState.split_between_processes`, each process generates
+its share with the KV-cache decode loop, and `gather_object` reassembles the
+results on every rank.
+
+Run:  python examples/inference/distributed_generate.py
+      accelerate-tpu launch --cpu --num_processes 2 examples/inference/distributed_generate.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import numpy as np
+
+from accelerate_tpu import GenerationConfig, PartialState, generate
+from accelerate_tpu.models.transformer import Transformer, TransformerConfig
+from accelerate_tpu.utils.operations import gather_object
+
+# Start up the distributed environment without needing the Accelerator
+# (same entry as the reference).
+state = PartialState()
+
+# A small randomly-initialized causal LM stands in for a pretrained checkpoint
+# (no hub egress here); load real weights with load_checkpoint_and_dispatch.
+cfg = TransformerConfig(
+    vocab_size=1024, hidden_size=128, intermediate_size=256,
+    num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=256,
+)
+model = Transformer(cfg)
+params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"]
+
+# token-id "prompts": 8 sequences of varying content, padded to one length
+rng = np.random.default_rng(0)
+prompts = [rng.integers(2, cfg.vocab_size, size=8).tolist() for _ in range(8)]
+
+gen = GenerationConfig(max_new_tokens=16, do_sample=False)
+
+results = []
+with state.split_between_processes(prompts) as my_prompts:
+    if my_prompts:
+        input_ids = np.asarray(my_prompts, np.int32)
+        sequences, _ = generate(model, params, input_ids, gen)
+        results = np.asarray(sequences)[:, input_ids.shape[1]:].tolist()
+
+# every rank ends up with the full, ordered result list
+all_results = [seq for shard in gather_object([results]) for seq in shard]
+state.print(f"{len(all_results)} continuations generated across {state.num_processes} process(es)")
+state.print(f"first continuation: {all_results[0]}")

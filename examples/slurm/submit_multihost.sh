@@ -1,0 +1,38 @@
+#!/bin/bash
+# Multi-host TPU pod (reference examples/slurm/submit_multinode.sh analog).
+# N hosts x 1 JAX process; rendezvous at the first node's IP via
+# jax.distributed (the reference's MASTER_ADDR/c10d analog).
+
+#SBATCH --job-name=accelerate-tpu-pod
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                    # number of TPU hosts in the pod slice
+#SBATCH --ntasks-per-node=1          # ONE process per host drives all local chips
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+######################
+### Set environment ##
+######################
+source activate_env.sh
+
+######################
+#### Set network #####
+######################
+head_node_ip=$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n 1)
+######################
+
+export LAUNCHER="accelerate-tpu launch \
+    --num_machines $SLURM_NNODES \
+    --machine_rank \$SLURM_PROCID \
+    --main_process_ip $head_node_ip \
+    --main_process_port 8476 \
+    --mixed_precision bf16 \
+    --mesh dp=$SLURM_NNODES,fsdp=-1 --dcn_mesh dp=$SLURM_NNODES \
+    "
+SCRIPT=examples/complete_nlp_example.py
+SCRIPT_ARGS="--checkpointing_steps epoch"
+
+# srun expands $SLURM_PROCID per task -> each host gets its machine_rank.
+srun bash -c "$LAUNCHER $SCRIPT $SCRIPT_ARGS"

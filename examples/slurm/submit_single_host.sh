@@ -1,0 +1,24 @@
+#!/bin/bash
+# Single TPU host (reference examples/slurm/submit_multigpu.sh analog).
+# One JAX process drives every chip on the host — no per-chip task fan-out.
+
+#SBATCH --job-name=accelerate-tpu
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1          # ONE process per TPU host
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+######################
+### Set environment ##
+######################
+source activate_env.sh               # your venv/conda activation
+
+SCRIPT=examples/nlp_example.py
+SCRIPT_ARGS="--mixed_precision bf16"
+
+# The launcher auto-sets OMP/BLAS thread counts; add --numa_affinity on
+# 2-socket hosts if dataloader throughput matters.
+srun accelerate-tpu launch $SCRIPT $SCRIPT_ARGS

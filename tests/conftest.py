@@ -26,6 +26,31 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Modules whose tests are compile-heavy (big jitted programs, pallas interpret
+# mode), fork real processes, or smoke-run example scripts.  `make test_fast`
+# deselects them (`-m "not slow"`) for a < 3 min developer loop — the
+# reference's Makefile test-split analog (Makefile:25-72).
+SLOW_MODULES = {
+    "test_examples",
+    "test_multiprocess",
+    "test_generation",
+    "test_pipeline",
+    "test_flash_attention",
+    "test_ring_attention",
+    "test_fp8",
+    "test_quantization",
+    "test_big_modeling",
+    "test_moe",
+    "test_memory_and_local_sgd",
+    "test_tensor_parallel",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(autouse=True)
 def reset_singleton_state():

@@ -130,3 +130,33 @@ def test_feature_automatic_gradient_accumulation():
     # the effective batch at 64
     assert "batch_size=32 x accum=2" in out
     assert "[64, 32]" in out
+
+
+def test_feature_cross_validation():
+    out = run_example("by_feature/cross_validation.py", "--num_folds", "2")
+    assert "ensemble of 2 folds" in out
+
+
+def test_feature_schedule_free():
+    out = run_example("by_feature/schedule_free.py", "--num_epochs", "1")
+    assert "eval_acc(schedule-free params)" in out
+
+
+def test_inference_distributed_generate():
+    out = run_example("inference/distributed_generate.py")
+    assert "8 continuations generated" in out
+
+
+def test_inference_pipeline_generate():
+    out = run_example("inference/pipeline_generate.py")
+    assert "pipeline over 2 stage(s)" in out
+
+
+def test_bench_smoke_tasks():
+    """The zero3/fsdp BASELINE bench configs run end to end (tiny geometry)."""
+    import json
+
+    for task in ("zero3", "fsdp"):
+        env_out = run_example(os.path.join("..", "bench.py"), "--task", task, "--smoke")
+        row = json.loads([l for l in env_out.splitlines() if l.startswith("{")][-1])
+        assert row["unit"] == "samples/s/chip" and row["value"] > 0

@@ -75,3 +75,245 @@ def test_custom_tracker_instance_passthrough(tmp_path):
 
 def test_json_available():
     assert "json" in get_available_trackers()
+
+
+# ---------------------------------------------------------------------------
+# Mocked backend trackers (reference tests/test_tracking.py mocks each cloud
+# tracker; here fake modules are injected into sys.modules so every tracker
+# class executes its full init/config/log/finish protocol without the real
+# backends installed).
+# ---------------------------------------------------------------------------
+import sys
+import types
+from unittest import mock
+
+
+class _Recorder:
+    """Records method calls as (name, args, kwargs) tuples."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        def method(*args, **kwargs):
+            self.calls.append((name, args, kwargs))
+            return None
+
+        return method
+
+    def named(self, name):
+        return [c for c in self.calls if c[0] == name]
+
+
+def _fake_module(name, **attrs):
+    m = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    return m
+
+
+class TestMockedTrackers:
+    def test_wandb_tracker(self):
+        from accelerate_tpu.tracking import WandBTracker
+
+        run = _Recorder()
+        config = _Recorder()
+        fake = _fake_module("wandb", init=lambda **kw: run, config=config)
+        with mock.patch.dict(sys.modules, {"wandb": fake}):
+            t = WandBTracker("proj", entity="me")
+            assert t.tracker is run
+            t.store_init_configuration({"lr": 0.1})
+            t.log({"loss": 1.0}, step=3)
+            t.finish()
+        assert config.named("update")[0][1][0] == {"lr": 0.1}
+        (name, args, kwargs) = run.named("log")[0]
+        assert args[0] == {"loss": 1.0} and kwargs["step"] == 3
+        assert run.named("finish")
+
+    def test_comet_tracker(self):
+        from accelerate_tpu.tracking import CometMLTracker
+
+        writer = _Recorder()
+        fake = _fake_module("comet_ml", Experiment=lambda **kw: writer)
+        with mock.patch.dict(sys.modules, {"comet_ml": fake}):
+            t = CometMLTracker("proj")
+            t.store_init_configuration({"bs": 8})
+            t.log({"acc": 0.9}, step=2)
+            t.finish()
+        assert writer.named("log_parameters")[0][1][0] == {"bs": 8}
+        assert writer.named("set_step")[0][1][0] == 2
+        assert writer.named("log_metrics")[0][1][0] == {"acc": 0.9}
+        assert writer.named("end")
+
+    def test_aim_tracker(self, tmp_path):
+        from accelerate_tpu.tracking import AimTracker
+
+        class FakeRun:
+            def __init__(self, repo=None, **kw):
+                self.repo = repo
+                self.items = {}
+                self.tracked = []
+                self.closed = False
+
+            def __setitem__(self, k, v):
+                self.items[k] = v
+
+            def track(self, v, name=None, step=None, **kw):
+                self.tracked.append((name, v, step))
+
+            def close(self):
+                self.closed = True
+
+        fake = _fake_module("aim", Run=FakeRun)
+        with mock.patch.dict(sys.modules, {"aim": fake}):
+            t = AimTracker("run1", logging_dir=str(tmp_path))
+            t.store_init_configuration({"lr": 0.5})
+            t.log({"loss": 2.0}, step=1)
+            t.finish()
+        w = t.tracker
+        assert w.repo == str(tmp_path)
+        assert w.name == "run1"
+        assert w.items["hparams"] == {"lr": 0.5}
+        assert w.tracked == [("loss", 2.0, 1)]
+        assert w.closed
+
+    def test_mlflow_tracker(self):
+        from accelerate_tpu.tracking import MLflowTracker
+
+        rec = _Recorder()
+        active_run = object()
+
+        fake = _fake_module(
+            "mlflow",
+            get_experiment_by_name=lambda name: None,
+            create_experiment=lambda name: "exp1",
+            start_run=lambda **kw: (rec.calls.append(("start_run", (), kw)), active_run)[1],
+            log_params=lambda params: rec.calls.append(("log_params", (params,), {})),
+            log_metrics=lambda metrics, step=None: rec.calls.append(
+                ("log_metrics", (metrics,), {"step": step})
+            ),
+            end_run=lambda: rec.calls.append(("end_run", (), {})),
+        )
+        with mock.patch.dict(sys.modules, {"mlflow": fake}):
+            t = MLflowTracker("proj")
+            assert t.tracker is active_run
+            # >100 params exercises the chunked upload path
+            many = {f"p{i}": i for i in range(150)}
+            t.store_init_configuration(many)
+            t.log({"loss": 3.0, "note": "skip-me"}, step=7)
+            t.finish()
+        param_chunks = rec.named("log_params")
+        assert len(param_chunks) == 2  # 100 + 50
+        assert sum(len(c[1][0]) for c in param_chunks) == 150
+        metrics_call = rec.named("log_metrics")[0]
+        assert metrics_call[1][0] == {"loss": 3.0}  # non-numeric dropped
+        assert metrics_call[2]["step"] == 7
+        assert rec.named("end_run")
+
+    def test_clearml_tracker(self):
+        from accelerate_tpu.tracking import ClearMLTracker
+
+        clogger = _Recorder()
+
+        class FakeTask:
+            connected = None
+            closed = False
+
+            @staticmethod
+            def init(project_name=None, **kw):
+                task = FakeTask()
+                return task
+
+            def connect_configuration(self, values):
+                FakeTask.connected = values
+
+            def get_logger(self):
+                return clogger
+
+            def close(self):
+                FakeTask.closed = True
+
+        fake = _fake_module("clearml", Task=FakeTask)
+        with mock.patch.dict(sys.modules, {"clearml": fake}):
+            t = ClearMLTracker("proj")
+            t.store_init_configuration({"wd": 0.01})
+            t.log({"train/loss": 1.0}, step=4)   # title/series split
+            t.log({"acc": 0.5})                   # single value, no step
+            t.finish()
+        assert FakeTask.connected == {"wd": 0.01}
+        scalar = clogger.named("report_scalar")[0]
+        assert scalar[1] == ("train", "loss", 1.0, 4)
+        single = clogger.named("report_single_value")[0]
+        assert single[1] == ("acc", 0.5)
+        assert FakeTask.closed
+
+    def test_dvclive_tracker(self):
+        from accelerate_tpu.tracking import DVCLiveTracker
+
+        class FakeLive:
+            def __init__(self, **kw):
+                self.params = None
+                self.metrics = []
+                self.step = None
+                self.steps = 0
+                self.ended = False
+
+            def log_params(self, values):
+                self.params = values
+
+            def log_metric(self, k, v, **kw):
+                self.metrics.append((k, v, self.step))
+
+            def next_step(self):
+                self.steps += 1
+
+            def end(self):
+                self.ended = True
+
+        fake = _fake_module("dvclive", Live=FakeLive)
+        with mock.patch.dict(sys.modules, {"dvclive": fake}):
+            t = DVCLiveTracker("run")
+            t.store_init_configuration({"opt": "adam"})
+            t.log({"loss": 0.3}, step=5)
+            t.finish()
+        live = t.tracker
+        assert live.params == {"opt": "adam"}
+        assert live.metrics == [("loss", 0.3, 5)]
+        assert live.steps == 1 and live.ended
+
+    def test_tensorboard_tracker_real(self, tmp_path):
+        # torch.utils.tensorboard is present in this image: run it for real.
+        from accelerate_tpu.tracking import TensorBoardTracker
+
+        t = TensorBoardTracker("run_tb", logging_dir=str(tmp_path))
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0, "msg": "hi", "grouped": {"a": 1.0}}, step=0)
+        t.finish()
+        assert any((tmp_path / "run_tb").iterdir())  # event files written
+
+    def test_accelerator_log_with_mocked_wandb(self, tmp_path):
+        # end-to-end: Accelerator.init_trackers/log/end_training over a mock
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.utils import ProjectConfiguration
+
+        run = _Recorder()
+        config = _Recorder()
+        fake = _fake_module("wandb", init=lambda **kw: run, config=config)
+        with mock.patch.dict(sys.modules, {"wandb": fake}):
+            with mock.patch(
+                "accelerate_tpu.tracking._AVAILABILITY",
+                {**__import__("accelerate_tpu.tracking", fromlist=["x"])._AVAILABILITY,
+                 "wandb": lambda: True},
+            ):
+                acc = Accelerator(
+                    log_with="wandb",
+                    project_config=ProjectConfiguration(
+                        project_dir=str(tmp_path), logging_dir=str(tmp_path)
+                    ),
+                )
+                acc.init_trackers("proj", config={"batch": 4})
+                acc.log({"loss": 9.0}, step=1)
+                acc.end_training()
+        assert config.named("update")[0][1][0] == {"batch": 4}
+        assert run.named("log")[0][1][0] == {"loss": 9.0}
+        assert run.named("finish")
