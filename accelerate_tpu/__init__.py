@@ -30,6 +30,7 @@ from .big_modeling import (
     disk_offload,
     dispatch_params,
     init_empty_weights,
+    init_params_on_host,
     load_checkpoint_and_dispatch,
     make_layer_plan,
     shard_params_for_inference,

@@ -217,6 +217,8 @@ class Accelerator:
         self._save_model_state_pre_hooks: Dict[Any, Callable] = {}
         self._load_model_state_pre_hooks: Dict[Any, Callable] = {}
         self._jit_cache: Dict[Any, Callable] = {}
+        self._chunk_info = None  # set by create_train_state under offload_optimizer
+        self._offload_master = False
         # Most recent TrainState this accelerator created or stepped — the handle
         # AcceleratedOptimizer.state_dict()/load_state_dict() round-trips through.
         # _latest_state_by_tx disambiguates multiple optimizers: states are also
@@ -553,12 +555,51 @@ class Accelerator:
             rng = jax.random.PRNGKey(seed)
         params = self.policy.cast_to_param(params)
 
+        # Host-offloaded optimizer: rebuild tx as chained per-chunk masked
+        # transforms so sync-step updates stream the moments through HBM in
+        # bounded chunks (utils/chunked_update.py; the whole-state round-trip
+        # OOMs exactly in the bigger-than-HBM case the offload targets).
+        self._chunk_info = None
+        self._offload_master = False
+        use_master = False
+        fsdp_plugin = self.effective_fsdp_plugin
+        if (
+            fsdp_plugin is not None
+            and fsdp_plugin.offload_optimizer
+            and fsdp_plugin.offload_update_chunk_mb > 0
+        ):
+            from .utils.chunked_update import build_chunked_tx, with_master_weights
+
+            use_master = fsdp_plugin.offload_master_weights
+            if use_master is None:
+                use_master = self.policy.compute_dtype != jnp.float32
+            if use_master:
+                # ZeRO-Offload weight split: device holds compute-dtype working
+                # weights; the fp32 masters live inside the (host-offloaded,
+                # chunked) optimizer state.  Kills both the fp32 param residency
+                # and the cast copy in HBM.  tx.init sees the FULL-precision
+                # params (masters must seed from fp32, not a bf16 round-trip);
+                # the working copy is downcast after creation in init_fn.
+                tx = with_master_weights(tx, master_dtype=self.policy.param_dtype)
+            self._offload_master = bool(use_master)
+
+            tx, info = build_chunked_tx(
+                tx, params, fsdp_plugin.offload_update_chunk_mb * 2**20
+            )
+            if info is not None:
+                info["master"] = bool(use_master)
+                info["params_treedef"] = jax.tree_util.tree_structure(params)
+                self._chunk_info = info
+
         grad_accum_dtype = None
         if self.collective_handler and self.collective_handler.grad_reduce_dtype:
             from .utils.dataclasses import TENSOR_DTYPES
 
             grad_accum_dtype = TENSOR_DTYPES[self.collective_handler.grad_reduce_dtype]
+        if use_master and grad_accum_dtype is None:
+            grad_accum_dtype = self.policy.compute_dtype  # buffer matches the wire
         powersgd = self._powersgd_config()
+        compute_dtype = self.policy.compute_dtype
 
         def init_fn(p):
             ts = TrainState.create(
@@ -580,6 +621,13 @@ class Accelerator:
                 rng=rng,
                 grad_accum_dtype=grad_accum_dtype,
             )
+            if use_master:
+                # downcast the working copy AFTER tx.init seeded fp32 masters
+                ts = ts.replace(
+                    params=jax.tree_util.tree_map(
+                        lambda x: x.astype(compute_dtype), ts.params
+                    )
+                )
             if powersgd is not None:
                 from .parallel.compression import powersgd_init
 
@@ -596,7 +644,74 @@ class Accelerator:
 
         abstract = jax.eval_shape(init_fn, params)
         shardings = self._train_state_shardings(abstract)
-        return self._track_state(self._place_with_offload(init_fn, params, shardings))
+        if self._chunk_info is not None:
+            return self._track_state(
+                self._create_chunked_offload_state(init_fn, params, abstract, shardings)
+            )
+        return self._track_state(
+            self._place_with_offload(init_fn, params, shardings, clear_after=True)
+        )
+
+    def _create_chunked_offload_state(self, init_fn, params, abstract, shardings):
+        """Creation path for chunked host-offloaded states: one small program
+        per optimizer chunk instead of one state-sized program.
+
+        A single init program would hold the fp32 operand, the sliced view,
+        and every master/moment as device temps before they reach host memory
+        — state-sized HBM, exactly what cannot fit.  Here the non-optimizer
+        fields build in one small program, then each chunk's masked-init runs
+        with only its own leaves: masters seed from the ORIGINAL fp32 params
+        (the chunk programs receive them, not the downcast working copy) and
+        stream straight to their host placement.
+        """
+        from jax.tree_util import tree_flatten, tree_unflatten
+
+        info = self._chunk_info
+
+        def base_fn(p):
+            from jax.memory import Space
+
+            # host-resident source params (init_params_on_host) stream in;
+            # the unused opt_state computation is dead code XLA eliminates
+            p = jax.device_put(p, Space.Device)
+            return init_fn(p).replace(opt_state=())
+
+        base_shardings = self._train_state_shardings(jax.eval_shape(base_fn, params))
+        base = self._place_with_offload(base_fn, params, base_shardings)
+
+        opt_abstract = abstract.opt_state
+        opt_shardings = shardings.opt_state
+        p_leaves, _ = tree_flatten(params)
+        meta = info["meta"]
+        n_view = info["n_view_leaves"]
+        view_treedef = info["view_treedef"]
+
+        opt_states = []
+        for i, (group, masked) in enumerate(zip(info["groups"], info["masked"])):
+            orig_ids = sorted({meta[v][0] for v in group})
+            orig_pos = {j: k for k, j in enumerate(orig_ids)}
+
+            def chunk_init(chunk_leaves, group=group, masked=masked, orig_pos=orig_pos):
+                from jax.memory import Space
+
+                from .utils.chunked_update import fill_view
+
+                # compute happens in device space; host-resident source leaves
+                # (init_params_on_host) stream in here (no-op for device args)
+                chunk_leaves = jax.device_put(chunk_leaves, Space.Device)
+                full_v = fill_view(group, meta, orig_pos, chunk_leaves, n_view)
+                return masked.init(tree_unflatten(view_treedef, full_v))
+
+            chunk_leaves = [p_leaves[j] for j in orig_ids]
+            placed = jax.jit(chunk_init, out_shardings=opt_shardings[i])(chunk_leaves)
+            # serialize chunk inits: their stream buffers must not coexist
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x,
+                placed,
+            )
+            opt_states.append(placed)
+        jax.clear_caches()  # drop the init executables' HBM plans (see _place_with_offload)
+        return base.replace(opt_state=tuple(opt_states))
 
     def _train_state_shardings(self, abstract_state):
         plugin = self.effective_fsdp_plugin
@@ -692,20 +807,45 @@ class Accelerator:
         shardings = self._train_state_shardings(abstract)
         return self._track_state(self._place_with_offload(lambda s: s, state, shardings))
 
-    def _place_with_offload(self, init_fn, operand, shardings):
-        """jit into device shardings, then move host-offloaded leaves out of HBM.
+    def _place_with_offload(self, init_fn, operand, shardings, clear_after: bool = False):
+        """jit directly into the target shardings, host memory kinds included.
 
-        XLA cannot jit-emit host-memory outputs directly (annotate_device_placement
-        needs sharded side-effect ops), hence the two-phase placement.
+        Emitting pinned-host outputs straight from the init program keeps the
+        creation-time HBM peak at the *device-resident* leaves only — the
+        two-phase fallback (device first, then device_put to host) transiently
+        materializes the whole state in HBM, which is exactly what cannot fit
+        in the bigger-than-HBM case the offload targets (1.5B Adam: ~21 GB).
         """
-        device_shardings = jax.tree_util.tree_map(_strip_memory_kind, shardings)
-        placed = jax.jit(init_fn, out_shardings=device_shardings)(operand)
-        if any(
+        has_host = any(
             getattr(s, "memory_kind", None) == "pinned_host"
             for s in jax.tree_util.tree_leaves(
                 shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
             )
-        ):
+        )
+        if has_host:
+            try:
+                placed = jax.jit(init_fn, out_shardings=shardings)(operand)
+                if clear_after:
+                    # Loaded executables keep their HBM allocation plans
+                    # reserved (init programs are state-sized); for a
+                    # bigger-than-HBM state those reservations crowd out the
+                    # train step's compile.  Only at creation time — clearing
+                    # here on the generic reshard path would silently drop the
+                    # user's already-compiled steps.
+                    jax.tree_util.tree_map(
+                        lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x,
+                        placed,
+                    )
+                    jax.clear_caches()
+                return placed
+            except (ValueError, NotImplementedError) as e:  # older runtimes
+                logger.warning_once(
+                    f"direct host-memory placement unsupported ({e}); falling back "
+                    "to two-phase creation — the full state transiently occupies HBM."
+                )
+        device_shardings = jax.tree_util.tree_map(_strip_memory_kind, shardings)
+        placed = jax.jit(init_fn, out_shardings=device_shardings)(operand)
+        if has_host:
             placed = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(x, s) if isinstance(x, jax.Array) else x,
                 placed,
@@ -860,11 +1000,27 @@ class Accelerator:
                 "with `loss_fn._pp_aware = True`), or drop pp_degree from "
                 "ModelParallelPlugin."
             )
+        sp_size = mesh_lib.mesh_axis_size(self.mesh, "sp")
+        if sp_size > 1 and not getattr(loss_fn, "_sp_aware", False):
+            raise ValueError(
+                f"The mesh has an sp axis of size {sp_size} but this loss_fn does not "
+                "shard the sequence: those devices would silently replicate compute. "
+                "Use a ring-attention model (TransformerConfig(attention_impl='ring') "
+                "with lm_loss_fn — parallel/ring_attention.py), mark a custom "
+                "sequence-sharded loss with `loss_fn._sp_aware = True`, or drop "
+                "sp_degree from ModelParallelPlugin."
+            )
         wrapped_loss = self._wrap_loss_fn(loss_fn, has_aux)
         wrapped_loss = self._maybe_remat(wrapped_loss)
         accum = self.gradient_accumulation_steps
         policy = self.policy
         fp16 = self._use_loss_scaling
+
+        # Chunked offloaded updates (create_train_state built a chained-masked
+        # tx): the in-graph apply is disabled and sync steps run one bounded
+        # jitted program per chunk instead (utils/chunked_update.py).
+        chunk_info = getattr(self, "_chunk_info", None)
+        chunked = chunk_info is not None
         # Gradient carry dtype (the DDP fp16/bf16 compression-hook analog):
         # grads are cast to this dtype right after the backward pass, halving
         # the accumulation buffer and any cross-step traffic under bf16.  Note
@@ -872,6 +1028,13 @@ class Accelerator:
         # (XLA reduce-scatters the bf16 dot-transpose partials under a bf16
         # policy before this cast); averaging/clipping/update stay fp32.
         reduce_dtype = jnp.float32
+        master_active = bool(getattr(self, "_offload_master", False))
+        if master_active:
+            # ZeRO-Offload wire format: grads/avg ride in the compute dtype
+            # (the fp32 upcast happens inside the master update) — half the
+            # grad buffer and stream traffic.  Applies with or without
+            # chunking: create_train_state sized grad_accum to match.
+            reduce_dtype = policy.compute_dtype
         if self.collective_handler and self.collective_handler.grad_reduce_dtype:
             if accum > 1:
                 from .utils.dataclasses import TENSOR_DTYPES
@@ -891,6 +1054,12 @@ class Accelerator:
         offload_params, offload_opt = self._offload_flags(warn=True)
         if offload_opt or offload_params:
             donate = False  # donation of host-resident buffers is rejected by XLA
+
+        user_donate = donate
+        if chunked:
+            # the wrapper re-wraps the INPUT param buffers into the next state
+            # (params never round-trip the grad program); donation would free them
+            donate = False
 
         powersgd = self._powersgd_config()
         mesh = self.mesh
@@ -998,13 +1167,26 @@ class Accelerator:
                 acc = grads
                 do_sync = jnp.asarray(True)
 
-            avg = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32) / count.astype(jnp.float32), acc
-            )
-            gnorm = global_norm(avg)
+            # Norm + clip without materializing a second full-precision grad
+            # tree: the norm reduces the buffer per-leaf in fp32 (fused, no
+            # buffer), and the 1/count average folds into one elementwise
+            # scale with the clip factor.  norm(acc)/count == norm(avg), so
+            # the reported grad_norm and the clip math are unchanged.  This
+            # halves the step's transient footprint — decisive when the
+            # buffer is params-sized and HBM is the constraint (zero3 bench).
+            inv_count = 1.0 / count.astype(jnp.float32)
+            gnorm = global_norm(acc) * inv_count
+            scale_factor = inv_count
             if max_grad_norm is not None:
                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                avg = jax.tree_util.tree_map(lambda g: g * clip, avg)
+                scale_factor = scale_factor * clip
+            # Offloaded-master updates upcast against fp32 masters, so their
+            # wire rides reduce_dtype; the plain in-graph apply keeps the
+            # documented fp32 avg — a bf16/fp16 carry buffer upcasts here.
+            avg_dtype = reduce_dtype if (chunked or master_active) else jnp.float32
+            avg = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * scale_factor).astype(avg_dtype), acc
+            )
             if max_grad_value is not None:
                 avg = jax.tree_util.tree_map(
                     lambda g: jnp.clip(g, -max_grad_value, max_grad_value), avg
@@ -1025,17 +1207,15 @@ class Accelerator:
                 return st
 
             applied = jnp.logical_and(do_sync, finite)
-            new_state = jax.lax.cond(applied, do_apply, skip_apply, (state, avg))
             # bookkeeping: reset buffers on sync (applied or overflow-skipped)
+            new_accum = None
             if accum > 1:
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
                 new_accum = jax.tree_util.tree_map(
                     lambda z, a: jnp.where(do_sync, z, a), zeros, acc
                 )
-                new_state = new_state.replace(grad_accum=new_accum)
-            new_state = new_state.replace(
-                micro_step=jnp.where(do_sync, 0, count), rng=new_rng, comm_state=new_comm
-            )
+            new_micro = jnp.where(do_sync, 0, count)
+            new_scale = None
             if fp16:
                 new_scale = jax.lax.cond(
                     do_sync,
@@ -1043,10 +1223,6 @@ class Accelerator:
                     lambda ls: ls,
                     state.loss_scale,
                 )
-                new_state = new_state.replace(loss_scale=new_scale)
-
-            if offload_params:
-                new_state = new_state.replace(params=jax.device_put(new_state.params, Space.Host))
 
             metrics = {
                 "loss": loss,
@@ -1056,6 +1232,36 @@ class Accelerator:
             }
             if has_aux:
                 metrics["aux"] = aux
+
+            if chunked:
+                # Slim outputs: params and (host-resident) opt state are NOT
+                # program outputs — an un-donated pass-through output would be
+                # a params-sized HBM copy, which is exactly the headroom the
+                # chunked offload path exists to free.  The wrapper re-wraps
+                # the input buffers with these small fields.  The grad wire
+                # rides reduce_dtype (XLA fuses the fp32 clip math into the
+                # cast, so no fp32 tree materializes).
+                small = {
+                    "micro_step": new_micro,
+                    "rng": new_rng,
+                    "grad_accum": new_accum,
+                    "loss_scale": new_scale,
+                    "comm_state": new_comm,
+                }
+                return small, metrics, avg
+
+            new_state = jax.lax.cond(applied, do_apply, skip_apply, (state, avg))
+            if accum > 1:
+                new_state = new_state.replace(grad_accum=new_accum)
+            new_state = new_state.replace(
+                micro_step=new_micro, rng=new_rng, comm_state=new_comm
+            )
+            if fp16:
+                new_state = new_state.replace(loss_scale=new_scale)
+
+            if offload_params:
+                new_state = new_state.replace(params=jax.device_put(new_state.params, Space.Host))
+
             return new_state, metrics
 
         jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
@@ -1066,19 +1272,106 @@ class Accelerator:
             force = bool(
                 (gs.sync_with_dataloader and gs.end_of_dataloader) or gs.sync_each_batch
             )
-            new_state, metrics = jitted(state, batch, force)
-            self._track_state(new_state)
+            if chunked:
+                # the layout was captured at compile time; a state from a
+                # different create_train_state call has a different treedef
+                if jax.tree_util.tree_structure(state.params) != chunk_info["params_treedef"]:
+                    raise ValueError(
+                        "This compiled step's chunked-offload layout does not match "
+                        "the given state's param tree. compile_train_step binds to "
+                        "the most recent create_train_state — recompile the step "
+                        "after creating each offloaded train state."
+                    )
+                small, metrics, avg = jitted(state, batch, force)
+                new_state = state.replace(
+                    micro_step=small["micro_step"],
+                    rng=small["rng"],
+                    comm_state=small["comm_state"],
+                )
+                if small["grad_accum"] is not None:
+                    new_state = new_state.replace(grad_accum=small["grad_accum"])
+                if small["loss_scale"] is not None:
+                    new_state = new_state.replace(loss_scale=small["loss_scale"])
+            else:
+                if getattr(self, "_chunk_info", None) is not None:
+                    raise ValueError(
+                        "An offload-chunked train state exists but this step was "
+                        "compiled before create_train_state: the in-graph apply "
+                        "would round-trip the whole host-resident optimizer state "
+                        "through HBM. Call create_train_state first, then "
+                        "compile_train_step."
+                    )
+                new_state, metrics = jitted(state, batch, force)
             # python-side GradientState mirror (reference _do_sync, accelerator.py:1001-1008);
             # a forced sync resets the counter so it stays aligned with micro_step.
             self.step += 1
             synced = force or (self.step % max(accum, 1) == 0)
             if synced:
                 self.step = 0
+            if chunked:
+                # Gate on the IN-GRAPH applied flag, not the python mirror:
+                # after a mid-accumulation checkpoint restore the two can
+                # disagree, and following the mirror would drop/double-apply
+                # updates.  The flag already folds in do_sync and fp16
+                # finiteness; the read costs one scalar D2H per call — noise
+                # next to the offload path's per-step host streaming.
+                if bool(jax.device_get(metrics["applied"])):
+                    new_state = self._apply_chunked(
+                        new_state, avg, chunk_info,
+                        opt_on_host=offload_opt, params_on_host=offload_params,
+                        donate=user_donate,
+                    )
+            self._track_state(new_state)
             gs._set_sync_gradients(synced)
             return new_state, metrics
 
         step._jitted = jitted
         return step
+
+    def _apply_chunked(
+        self, state: TrainState, avg, info, opt_on_host: bool, params_on_host: bool,
+        donate: bool = True,
+    ) -> TrainState:
+        """Optimizer update in bounded HBM chunks (utils/chunked_update.py).
+
+        Each chunk's moments stream host→HBM→host inside its own jitted
+        program, keeping peak HBM at O(chunk) instead of the whole optimizer
+        state.  The compiled chunk fns are cached on ``info`` itself (one
+        chunk layout per create_train_state call — a shared key would reuse
+        another state's treedef).
+        """
+        from .utils.chunked_update import make_chunk_apply
+
+        key = ("fns", opt_on_host, params_on_host, donate)
+        fns = info.get(key)
+        if fns is None:
+            fns = info[key] = [
+                make_chunk_apply(
+                    group, masked, info,
+                    opt_on_host=opt_on_host, params_on_host=params_on_host,
+                    donate=donate,
+                )
+                for group, masked in zip(info["groups"], info["masked"])
+            ]
+        p_leaves, p_def = jax.tree_util.tree_flatten(state.params)
+        g_leaves = jax.tree_util.tree_flatten(avg)[0]
+        opt_states = list(state.opt_state)
+        new_p = list(p_leaves)
+        for i, (fn, orig_ids) in enumerate(fns):
+            chunk_p = [new_p[j] for j in orig_ids]
+            chunk_g = [g_leaves[j] for j in orig_ids]
+            new_chunk_p, opt_states[i] = fn(chunk_p, chunk_g, opt_states[i])
+            # Barrier per chunk: the chunk programs are mutually independent, so
+            # async dispatch would let all their stream buffers coexist in HBM —
+            # exactly the O(opt state) peak this path exists to avoid.
+            new_chunk_p[0].block_until_ready()
+            for pos, j in enumerate(orig_ids):
+                new_p[j] = new_chunk_p[pos]
+        return state.replace(
+            params=jax.tree_util.tree_unflatten(p_def, new_p),
+            opt_state=tuple(opt_states),
+            step=state.step + 1,
+        )
 
     def compile_eval_step(self, eval_fn: Callable, *, donate: bool = False) -> Callable:
         """Compile an eval/predict step: ``eval_fn(params, batch[, rng])`` with policy cast."""

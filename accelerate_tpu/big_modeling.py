@@ -52,6 +52,43 @@ def init_empty_weights(model, *args, method: str = "init", rng=None, **kwargs):
     return shapes["params"] if isinstance(shapes, dict) and "params" in shapes else shapes
 
 
+def init_params_on_host(model, *args, method: str = "init", rng=None, **kwargs):
+    """Materialize freshly initialized parameters directly into pinned host
+    memory — the creation path for bigger-than-HBM training states.
+
+    Random init on-device would leave a full-precision parameter tree resident
+    in HBM while ``create_train_state`` builds the working copy and the
+    (host-offloaded) optimizer chunks; emitting the init program's outputs to
+    host memory keeps the HBM peak at transients only.  Falls back to plain
+    device init on backends without host memory support (CPU test rigs).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .parallel.sharding import supports_host_offload
+    from .state import PartialState
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    fn = getattr(model, method)
+
+    def run():
+        out = fn(rng, *args, **kwargs)
+        return out["params"] if isinstance(out, dict) and "params" in out else out
+
+    mesh = PartialState().mesh
+    if not supports_host_offload(mesh):
+        return jax.jit(run)()
+    host = NamedSharding(mesh, PartitionSpec(), memory_kind="pinned_host")
+    shapes = jax.eval_shape(run)
+    placed = jax.jit(
+        run, out_shardings=jax.tree_util.tree_map(lambda _: host, shapes)
+    )()
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x, placed
+    )
+    jax.clear_caches()  # drop the init executable's HBM plan before training compiles
+    return placed
+
+
 def checkpoint_shapes(
     checkpoint: str, files: Optional[Dict[str, str]] = None
 ) -> Dict[str, jax.ShapeDtypeStruct]:

@@ -28,6 +28,27 @@ from flax import struct
 from ..ops.attention import dot_product_attention
 
 
+def _constrain_sequence_parallel(x):
+    """Shard activations [B, S, H] over the sp axis (batch stays on the data
+    axes) so the ring path's shard_map sees already-sequence-sharded inputs —
+    without this, GSPMD may keep activations replicated and gather at the
+    shard_map boundary every layer."""
+    from ..state import PartialState, is_initialized
+
+    if not is_initialized():
+        return x
+    mesh = PartialState().mesh
+    from ..parallel.mesh import present_data_axes, sp_shardable
+
+    if not sp_shardable(mesh, x.shape[0], x.shape[1]):
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    data = present_data_axes(mesh)
+    spec = PartitionSpec(data if data else None, "sp", None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 _REMAT_POLICIES = {
     "full": None,  # save nothing / recompute all
     "nothing_saveable": "nothing_saveable",
@@ -63,7 +84,8 @@ class TransformerConfig:
     # ~1 extra activation set per layer — the usual MFU/memory middle ground)
     remat_policy: str = "full"
     scan_layers: bool = False          # roll layers into lax.scan
-    attention_impl: str = "xla"        # "xla" | "pallas" | "ring"
+    attention_impl: str = "xla"        # "xla" | "pallas" | "ring" (sp-axis sequence parallel)
+    ring_attention_layout: str = "contiguous"  # "contiguous" | "zigzag" (balanced causal ring)
     dropout_rate: float = 0.0
     # fp8 matmuls (TransformerEngine analog, ops/fp8.py): projection/MLP dots
     # quantize operands to e4m3 fwd / e5m2 bwd with just-in-time scaling.
@@ -101,6 +123,11 @@ class TransformerConfig:
             raise ValueError(
                 f"Unknown remat_policy {self.remat_policy!r}; "
                 f"choose from {sorted(_REMAT_POLICIES)}"
+            )
+        if self.ring_attention_layout not in ("contiguous", "zigzag"):
+            raise ValueError(
+                f"Unknown ring_attention_layout {self.ring_attention_layout!r}; "
+                "choose 'contiguous' or 'zigzag'"
             )
 
     @classmethod
@@ -247,7 +274,8 @@ class Attention(nn.Module):
             out = out.reshape(b, s, cfg.num_heads * hd)
             return dense("o_proj", cfg.hidden_size)(out), (k_cache, v_cache)
         out = dot_product_attention(
-            q, k, v, causal=True, implementation=cfg.attention_impl, segment_ids=segment_ids
+            q, k, v, causal=True, implementation=cfg.attention_impl,
+            segment_ids=segment_ids, ring_layout=cfg.ring_attention_layout
         )
         out = out.reshape(b, s, cfg.num_heads * hd)
         return dense("o_proj", cfg.hidden_size)(out)
@@ -362,6 +390,8 @@ class Transformer(nn.Module):
             name="embed_tokens",
         )
         x = embed(input_ids)
+        if cfg.attention_impl == "ring":
+            x = _constrain_sequence_parallel(x)
 
         new_cache = None
         if cfg.scan_layers:
@@ -492,4 +522,7 @@ def lm_loss_fn(model: Transformer):
             )
         return loss
 
+    # ring attention shards the sequence over sp inside the forward; the
+    # trainer's sp>1 guard (compile_train_step) accepts sp-aware losses only
+    loss_fn._sp_aware = cfg.attention_impl == "ring"
     return loss_fn

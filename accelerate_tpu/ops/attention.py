@@ -39,6 +39,7 @@ def dot_product_attention(
     scale: Optional[float] = None,
     implementation: str = "xla",
     segment_ids: Optional[jax.Array] = None,
+    ring_layout: str = "contiguous",
 ) -> jax.Array:
     """BSHD attention. GQA supported (k/v may have fewer heads than q)."""
     if implementation == "pallas":
@@ -46,11 +47,45 @@ def dot_product_attention(
 
         return flash_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
     if implementation == "ring":
-        raise ValueError(
-            "ring attention runs over the `sp` mesh axis; call "
-            "accelerate_tpu.parallel.ring_attention_sharded(q, k, v, mesh) on global "
-            "arrays, or ring_attention(...) on local shards inside shard_map"
-        )
+        # Sequence-parallel path: shard_map ring over the active mesh's `sp`
+        # axis.  The mesh comes from the process state (set by Accelerator /
+        # PartialState); with no sp axis present, plain attention computes the
+        # same thing without the ring machinery, so fall through to XLA.
+        from ..state import PartialState, is_initialized
+
+        if not is_initialized():
+            raise ValueError(
+                "attention_impl='ring' needs the active mesh: construct "
+                "Accelerator/PartialState (with an sp-axis mesh) before the "
+                "forward, or call parallel.ring_attention_sharded(q, k, v, mesh) "
+                "directly with an explicit mesh."
+            )
+        mesh = PartialState().mesh
+        from ..parallel.mesh import mesh_axis_size, sp_shardable
+
+        if sp_shardable(mesh, q.shape[0], q.shape[1]):
+            from ..parallel.ring_attention import ring_attention_sharded
+
+            return ring_attention_sharded(
+                q, k, v, mesh,
+                causal=causal, scale=scale, segment_ids=segment_ids,
+                layout=ring_layout,
+            )
+        sp = mesh_axis_size(mesh, "sp")
+        if sp > 1 and q.shape[0] > 1:
+            # a real forward on an sp mesh that cannot shard would leave every
+            # sp device replicating the whole computation for the entire run —
+            # the silent-waste trap the trainer's sp guard exists to prevent.
+            # (batch-1 shapes are model.init probes; they fall through.)
+            raise ValueError(
+                f"attention_impl='ring' on an sp={sp} mesh requires seq "
+                f"divisible by sp and batch divisible by the data axes; got "
+                f"batch={q.shape[0]}, seq={q.shape[1]}. Pad the sequence (or "
+                "drop sp_degree) — falling back would silently replicate "
+                "compute across the sp devices."
+            )
+        # no sp axis / shape probes: the unsharded path computes the same result
+        implementation = "xla"
 
     # XLA path: grouped-query handled by repeating kv heads.
     n_q_heads, n_kv_heads = q.shape[2], k.shape[2]

@@ -158,3 +158,14 @@ def num_data_shards(mesh: Mesh) -> int:
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis] if axis in mesh.axis_names else 1
+
+
+def sp_shardable(mesh: Mesh, batch: int, seq: int) -> bool:
+    """Whether a [batch, seq, ...] activation can shard batch-over-data-axes and
+    seq-over-sp on this mesh.  Shared gate for the model's sp activation
+    constraint and the ring-attention dispatch — shape probes (``model.init``
+    with batch 1) and ragged tails fall back to the unsharded computation."""
+    if mesh_axis_size(mesh, "sp") <= 1:
+        return False
+    data_size = math.prod(mesh.shape[a] for a in present_data_axes(mesh)) or 1
+    return batch % data_size == 0 and seq % mesh.shape["sp"] == 0

@@ -64,7 +64,17 @@ def tree_finite(tree) -> jax.Array:
 
 
 def global_norm(tree) -> jax.Array:
-    return optax.global_norm(tree)
+    """L2 norm over a pytree, accumulated in fp32 regardless of leaf dtype.
+
+    optax.global_norm sums squares in the leaf dtype — a bf16 gradient buffer
+    (CollectiveKwargs.grad_reduce_dtype / the ZeRO-Offload wire format) would
+    overflow/round the reduction.  The per-leaf upcast fuses into the
+    reduction; no fp32 copy of the tree materializes.
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
 
 
 class TrainState(struct.PyTreeNode):

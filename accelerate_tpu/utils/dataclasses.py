@@ -329,6 +329,17 @@ class FullyShardedDataParallelPlugin:
     state_dict_type: StateDictType = StateDictType.SHARDED_STATE_DICT
     cpu_offload: bool = False          # offload sharded params to host between steps
     offload_optimizer: bool = False    # keep optimizer state in host memory
+    # Streaming granularity for host-offloaded optimizer updates: moments
+    # round-trip HBM in ~this many MB per jitted chunk on sync steps
+    # (utils/chunked_update.py — the DeepSpeedCPUAdam-parity piece).  0 restores
+    # the whole-state round-trip (only viable when opt state fits HBM spare).
+    offload_update_chunk_mb: int = 512
+    # ZeRO-Offload weight layout: keep fp32 master weights inside the
+    # (host-offloaded) optimizer state and store TrainState.params in the
+    # compute dtype — DeepSpeed's exact split (fp32 masters + moments on host,
+    # bf16/fp16 working weights on device).  None = auto: on when the
+    # optimizer is offloaded and the compute dtype is narrower than fp32.
+    offload_master_weights: Optional[bool] = None
     fsdp_axis_size: int = -1           # -1: all non-model-parallel devices
     backward_prefetch: str = "BACKWARD_PRE"  # parity no-op: XLA schedules prefetch
     use_orig_params: bool = True             # parity no-op: params are never flattened
@@ -403,6 +414,10 @@ class ZeroPlugin:
     # zero3_save_16bit_model, DeepSpeedPlugin stage3_gather_16bit_weights).
     zero3_save_16bit_model: bool = False
     train_micro_batch_size_per_gpu: Optional[int] = None
+    # Streaming granularity for the host-offloaded update (None = the FSDP
+    # plugin default, 512 MB).  Fewer/bigger chunks = fewer compiled chunk
+    # programs (compile time) at more HBM per stream.
+    offload_update_chunk_mb: Optional[int] = None
     # Note: the reference's zero3_init_flag (meta-device init) has no knob here
     # because create_train_state always initializes abstractly (jax.eval_shape +
     # out_shardings) — full state is never materialized on one device.  NVMe
@@ -440,12 +455,16 @@ class ZeroPlugin:
             2: ShardingStrategy.SHARD_GRAD_OP,
             3: ShardingStrategy.FULL_SHARD,
         }[self.zero_stage]
+        kwargs = {}
+        if self.offload_update_chunk_mb is not None:
+            kwargs["offload_update_chunk_mb"] = self.offload_update_chunk_mb
         return FullyShardedDataParallelPlugin(
             sharding_strategy=strategy,
             min_weight_size=0 if self.zero_stage == 3 else 2**12,
             cpu_offload=self.offload_param_device == "cpu",
             offload_optimizer=self.offload_optimizer_device == "cpu",
             shard_gradients=self.zero_stage >= 2,
+            **kwargs,
         )
 
 

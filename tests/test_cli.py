@@ -316,3 +316,35 @@ class TestSageMakerRefusal:
 
         with pytest.raises(ValueError, match="SageMaker"):
             launch_command(args)
+
+
+class TestEstimateTorchMeta:
+    """The torch-meta branch of estimate-memory (reference create_empty_model,
+    commands/estimate.py:60-130) — exercised from a local config.json, since
+    shape-only init needs no weights (and this env has no Hub egress)."""
+
+    def test_count_parameters_torch_meta(self, tmp_path):
+        from accelerate_tpu.commands.estimate import count_parameters
+
+        (tmp_path / "config.json").write_text(json.dumps({
+            "model_type": "gpt2", "n_embd": 32, "n_layer": 2, "n_head": 2,
+            "vocab_size": 128, "n_positions": 64,
+        }))
+        total, largest, name = count_parameters(str(tmp_path))
+        # embeddings: 128*32 + 64*32; per-layer attn/mlp blocks on top
+        assert total > 128 * 32
+        assert 0 < largest <= total
+        assert "GPT2" in name
+
+    def test_estimate_cli_local_torch_config(self, tmp_path, capsys):
+        from accelerate_tpu.commands.estimate import estimate_command, estimate_command_parser
+
+        (tmp_path / "config.json").write_text(json.dumps({
+            "model_type": "gpt2", "n_embd": 32, "n_layer": 2, "n_head": 2,
+            "vocab_size": 128, "n_positions": 64,
+        }))
+        parser = estimate_command_parser()
+        args = parser.parse_args([str(tmp_path), "--dtypes", "float32", "int8"])
+        estimate_command(args)
+        out = capsys.readouterr().out
+        assert "float32" in out and "int8" in out

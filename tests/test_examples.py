@@ -160,3 +160,8 @@ def test_bench_smoke_tasks():
         env_out = run_example(os.path.join("..", "bench.py"), "--task", task, "--smoke")
         row = json.loads([l for l in env_out.splitlines() if l.startswith("{")][-1])
         assert row["unit"] == "samples/s/chip" and row["value"] > 0
+
+
+def test_feature_ddp_comm_hook():
+    out = run_example("by_feature/ddp_comm_hook.py", "--num_epochs", "1")
+    assert "wire compression" in out

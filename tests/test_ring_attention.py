@@ -166,3 +166,111 @@ class TestZigzag:
         q, k, v = qkv
         with pytest.raises(ValueError, match="layout"):
             ring_attention_sharded(q, k, v, mesh, layout="striped")
+
+
+class TestFlagshipIntegration:
+    """attention_impl='ring' through the Accelerator trainer: sp axis does real
+    sequence-parallel work (the pp-style inert-axis trap is guarded)."""
+
+    def _train_once(self, acc, cfg, ids):
+        import optax
+
+        from accelerate_tpu.models.transformer import Transformer, lm_loss_fn
+
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+        state = acc.create_train_state(params=params, tx=optax.sgd(1e-2), seed=0)
+        step = acc.compile_train_step(lm_loss_fn(model), donate=False)
+        state, metrics = step(state, {"input_ids": ids})
+        return float(metrics["loss"])
+
+    def test_ring_model_trains_on_sp_mesh_and_matches_dp(self):
+        import numpy as np
+
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.models.transformer import TransformerConfig
+        from accelerate_tpu.state import AcceleratorState, GradientState
+        from accelerate_tpu.utils.dataclasses import ModelParallelPlugin
+
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (4, 64)), jnp.int32
+        )
+        base = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+        acc_ref = Accelerator(mesh={"dp": 8})
+        loss_ref = self._train_once(
+            acc_ref, TransformerConfig.tiny(**base), ids
+        )
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc_sp = Accelerator(
+            mesh={"dp": 2, "sp": 4},
+            megatron_lm_plugin=ModelParallelPlugin(sp_degree=4),
+        )
+        loss_sp = self._train_once(
+            acc_sp, TransformerConfig.tiny(attention_impl="ring", **base), ids
+        )
+        assert abs(loss_sp - loss_ref) < 2e-3, (loss_sp, loss_ref)
+
+    def test_zigzag_layout_matches_too(self):
+        import numpy as np
+
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.models.transformer import TransformerConfig
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, 128, (2, 64)), jnp.int32
+        )
+        base = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+        acc_ref = Accelerator(mesh={"dp": 8})
+        loss_ref = self._train_once(acc_ref, TransformerConfig.tiny(**base), ids)
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc_sp = Accelerator(mesh={"sp": 4})
+        loss_sp = self._train_once(
+            acc_sp,
+            TransformerConfig.tiny(
+                attention_impl="ring", ring_attention_layout="zigzag", **base
+            ),
+            ids,
+        )
+        assert abs(loss_sp - loss_ref) < 2e-3, (loss_sp, loss_ref)
+
+    def test_sp_mesh_rejects_non_sp_aware_loss(self):
+        import optax
+        import pytest as _pytest
+
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.models.transformer import Transformer, TransformerConfig, lm_loss_fn
+
+        acc = Accelerator(mesh={"sp": 4})
+        cfg = TransformerConfig.tiny()          # xla attention: not sp-aware
+        model = Transformer(cfg)
+        with _pytest.raises(ValueError, match="sp axis"):
+            acc.compile_train_step(lm_loss_fn(model))
+
+    def test_ring_without_state_raises_helpfully(self):
+        import pytest as _pytest
+
+        from accelerate_tpu.ops.attention import dot_product_attention
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        q = jnp.zeros((1, 8, 2, 4))
+        with _pytest.raises(ValueError, match="active mesh"):
+            dot_product_attention(q, q, q, implementation="ring")
+
+    def test_non_divisible_seq_raises_not_silent(self):
+        import pytest as _pytest
+
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.ops.attention import dot_product_attention
+
+        Accelerator(mesh={"sp": 4})
+        q = jnp.zeros((2, 65, 2, 4))  # seq 65 % 4 != 0, real batch
+        with _pytest.raises(ValueError, match="divisible"):
+            dot_product_attention(q, q, q, implementation="ring")
