@@ -1127,7 +1127,12 @@ class Accelerator:
                 check_vma=False,
             )(params, batch, sub, comm_state)
 
-        def _step(state: TrainState, batch, force_sync):
+        def _step(state: TrainState, batch, force_sync, sync_mode=None):
+            """``sync_mode``: None = runtime sync decision (the standard single
+            program); True/False = chunked mode's statically specialized sync /
+            micro programs — the sync program emits ``avg`` (aliased into the
+            donated accumulation buffer) and no ``grad_accum``, the micro
+            program the reverse, saving a params-sized buffer each."""
             from jax.memory import Space
 
             # Host-offloaded params stream to HBM for the step and back after
@@ -1162,7 +1167,10 @@ class Accelerator:
             count = state.micro_step + 1
             if accum > 1:
                 acc = jax.tree_util.tree_map(lambda a, g: a + g, state.grad_accum, grads)
-                do_sync = jnp.logical_or(force_sync, count >= accum)
+                if sync_mode is None:
+                    do_sync = jnp.logical_or(force_sync, count >= accum)
+                else:
+                    do_sync = jnp.asarray(bool(sync_mode))
             else:
                 acc = grads
                 do_sync = jnp.asarray(True)
@@ -1244,10 +1252,15 @@ class Accelerator:
                 small = {
                     "micro_step": new_micro,
                     "rng": new_rng,
-                    "grad_accum": new_accum,
+                    # the specialized sync program drops the (all-zeros) buffer
+                    # so `avg` can alias the donated accumulation input; the
+                    # wrapper refills zeros afterwards
+                    "grad_accum": None if sync_mode is True else new_accum,
                     "loss_scale": new_scale,
                     "comm_state": new_comm,
                 }
+                if sync_mode is False:
+                    return small, metrics
                 return small, metrics, avg
 
             new_state = jax.lax.cond(applied, do_apply, skip_apply, (state, avg))
@@ -1264,7 +1277,33 @@ class Accelerator:
 
             return new_state, metrics
 
-        jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
+        if chunked and accum > 1:
+            # Statically specialized micro/sync programs with the accumulation
+            # buffer as its own donated argument: XLA aliases it into the
+            # same-shaped new_accum (micro) or avg (sync) output, saving a
+            # params-sized buffer each — the margin on bigger-than-HBM configs.
+            def _split(sync_flag):
+                def fn(state_rest, accum_buf, batch):
+                    return _step(
+                        state_rest.replace(grad_accum=accum_buf), batch,
+                        jnp.asarray(sync_flag), sync_mode=sync_flag,
+                    )
+                return jax.jit(fn, donate_argnums=(1,))
+
+            prog_micro, prog_sync = _split(False), _split(True)
+
+            def jitted(state, batch, synced):
+                rest = state.replace(grad_accum=None)
+                prog = prog_sync if synced else prog_micro
+                out = prog(rest, state.grad_accum, batch)
+                return out if synced else (*out, None)
+        elif chunked:
+            _jit_once = jax.jit(_step, donate_argnums=())
+
+            def jitted(state, batch, synced):
+                return _jit_once(state, batch, jnp.asarray(True))
+        else:
+            jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
 
         @functools.wraps(loss_fn)
         def step(state, batch):
@@ -1282,7 +1321,14 @@ class Accelerator:
                         "the most recent create_train_state — recompile the step "
                         "after creating each offloaded train state."
                     )
-                small, metrics, avg = jitted(state, batch, force)
+                # Sync-ness derives from the STATE's counter (one scalar D2H),
+                # not a python mirror: the specialized micro/sync programs and
+                # checkpoint restores stay aligned by construction.
+                if accum > 1:
+                    synced = force or (int(jax.device_get(state.micro_step)) + 1 >= accum)
+                else:
+                    synced = True
+                small, metrics, avg = jitted(state, batch, synced)
                 new_state = state.replace(
                     micro_step=small["micro_step"],
                     rng=small["rng"],
@@ -1292,35 +1338,48 @@ class Accelerator:
                     new_state = new_state.replace(grad_accum=small["grad_accum"])
                 if small["loss_scale"] is not None:
                     new_state = new_state.replace(loss_scale=small["loss_scale"])
-            else:
-                if getattr(self, "_chunk_info", None) is not None:
-                    raise ValueError(
-                        "An offload-chunked train state exists but this step was "
-                        "compiled before create_train_state: the in-graph apply "
-                        "would round-trip the whole host-resident optimizer state "
-                        "through HBM. Call create_train_state first, then "
-                        "compile_train_step."
-                    )
-                new_state, metrics = jitted(state, batch, force)
+                self.step = 0 if synced else self.step + 1
+                if synced:
+                    # fp16 finiteness folds into the in-graph applied flag
+                    if bool(jax.device_get(metrics["applied"])):
+                        new_state = self._apply_chunked(
+                            new_state, avg, chunk_info,
+                            opt_on_host=offload_opt, params_on_host=offload_params,
+                            donate=user_donate,
+                        )
+                    if accum > 1:
+                        # the sync program dropped the accumulation buffer so
+                        # avg could alias it; refill zeros (after the chunk
+                        # applies, when avg's peak has passed)
+                        zkey = ("accum_zeros", id(chunk_info))
+                        zfn = self._jit_cache.get(zkey)
+                        if zfn is None:
+                            # donate avg: the zeros alias its (now dead) buffer
+                            # instead of allocating a third params-sized tensor
+                            zfn = self._jit_cache[zkey] = jax.jit(
+                                lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
+                                donate_argnums=(0,),
+                            )
+                        new_state = new_state.replace(grad_accum=zfn(avg))
+                self._track_state(new_state)
+                gs._set_sync_gradients(synced)
+                return new_state, metrics
+
+            if getattr(self, "_chunk_info", None) is not None:
+                raise ValueError(
+                    "An offload-chunked train state exists but this step was "
+                    "compiled before create_train_state: the in-graph apply "
+                    "would round-trip the whole host-resident optimizer state "
+                    "through HBM. Call create_train_state first, then "
+                    "compile_train_step."
+                )
+            new_state, metrics = jitted(state, batch, force)
             # python-side GradientState mirror (reference _do_sync, accelerator.py:1001-1008);
             # a forced sync resets the counter so it stays aligned with micro_step.
             self.step += 1
             synced = force or (self.step % max(accum, 1) == 0)
             if synced:
                 self.step = 0
-            if chunked:
-                # Gate on the IN-GRAPH applied flag, not the python mirror:
-                # after a mid-accumulation checkpoint restore the two can
-                # disagree, and following the mirror would drop/double-apply
-                # updates.  The flag already folds in do_sync and fp16
-                # finiteness; the read costs one scalar D2H per call — noise
-                # next to the offload path's per-step host streaming.
-                if bool(jax.device_get(metrics["applied"])):
-                    new_state = self._apply_chunked(
-                        new_state, avg, chunk_info,
-                        opt_on_host=offload_opt, params_on_host=offload_params,
-                        donate=user_donate,
-                    )
             self._track_state(new_state)
             gs._set_sync_gradients(synced)
             return new_state, metrics

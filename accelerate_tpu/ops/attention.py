@@ -72,11 +72,13 @@ def dot_product_attention(
                 layout=ring_layout,
             )
         sp = mesh_axis_size(mesh, "sp")
-        if sp > 1 and q.shape[0] > 1:
-            # a real forward on an sp mesh that cannot shard would leave every
-            # sp device replicating the whole computation for the entire run —
+        if sp > 1 and (q.shape[1] % sp != 0 or q.shape[0] > 1):
+            # A forward on an sp mesh that cannot shard would leave every sp
+            # device replicating the whole computation for the entire run —
             # the silent-waste trap the trainer's sp guard exists to prevent.
-            # (batch-1 shapes are model.init probes; they fall through.)
+            # Sequence divisibility always raises (init probes share the real
+            # seq, so a bad seq fails loudly at init too); only batch-1 shapes
+            # with a GOOD seq fall through (model.init probes on a dp+sp mesh).
             raise ValueError(
                 f"attention_impl='ring' on an sp={sp} mesh requires seq "
                 f"divisible by sp and batch divisible by the data axes; got "

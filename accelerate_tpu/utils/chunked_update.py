@@ -176,8 +176,7 @@ def flatten_view_meta(params: Any, spec) -> Tuple[Any, List[Tuple[int, int, int]
     return v_treedef, meta, len(v_leaves)
 
 
-def partition_view(meta: Sequence[Tuple[int, int, int]], sizes: Sequence[int],
-                   chunk_bytes: int) -> List[List[int]]:
+def partition_view(sizes: Sequence[int], chunk_bytes: int) -> List[List[int]]:
     """Greedily group view-leaf indices (flatten order, so slices of one leaf
     stay contiguous) to ~``chunk_bytes`` of moment footprint each."""
     groups: List[List[int]] = []
@@ -223,7 +222,7 @@ def build_chunked_tx(
         else:
             per_row = int(math.prod(shape)) // shape[0] if shape[0] else 1
             sizes.append(per_row * (e - s))
-    groups = partition_view(meta, sizes, chunk_bytes)
+    groups = partition_view(sizes, chunk_bytes)
     if len(groups) <= 1:
         return tx, None
     masked = [optax.masked(tx, _group_mask(view_treedef, n_view, g)) for g in groups]

@@ -263,15 +263,18 @@ def bench_zero3(smoke: bool = False, batch: int = 4):
             deepspeed_plugin=at.ZeroPlugin(
                 zero_stage=3,
                 offload_optimizer_device="cpu",
-                # ~9 chunk programs instead of ~36: compile time through the
-                # remote-compile path dominates otherwise
-                offload_update_chunk_mb=2048,
+                # ~21 chunk programs; transients run ~4x the chunk state
+                # (in+out copies + adam temps).  1 GB chunks leave reliable
+                # headroom next to the params+grads peak; bigger chunks are
+                # marginal on 16 GB and OOM intermittently.
+                offload_update_chunk_mb=1024,
             ),
             mesh={"fsdp": -1},
-            # the stream-the-optimizer cost amortizes over the accumulation
-            # window — how ZeRO-Offload is actually run (micro-steps touch
-            # only params+grads in HBM)
-            gradient_accumulation_steps=8,
+            # NB: accumulation would amortize the per-step optimizer stream,
+            # but a separate accumulation buffer adds a third params-sized
+            # bf16 tensor (params + buffer + backward grads) — at 2.1B params
+            # that exceeds a single 16 GB chip.  accum=1 reuses the grads as
+            # the buffer; multi-chip fsdp shards all three.
         ),
         baseline_note="BASELINE.md: GPT-2-XL ZeRO-3 + host offload — functional parity target; vs_baseline reports MFU",
         smoke=smoke,

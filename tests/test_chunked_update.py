@@ -84,7 +84,7 @@ class TestPartition:
 
 
 class TestChunkedTraining:
-    def _train(self, accelerator, steps=5, accum=False):
+    def _train(self, accelerator, steps=5):
         params = _params()
         state = accelerator.create_train_state(params=params, tx=optax.adamw(1e-2), seed=0)
         step = accelerator.compile_train_step(_loss_fn, max_grad_norm=1.0)
