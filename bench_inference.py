@@ -20,8 +20,10 @@ Either way ``effective stream GB/s`` — model bytes transferred per step / wall
 time — is the engine-quality number; ``vs_baseline`` compares it to the
 reference's ~25 GB/s OPT-30B CPU-offload figure.
 
-Presets: ``gpt2-xl`` (1.5B, the ZeRO-3/offload parity target) by default on
-TPU; ``--preset tiny`` for CPU smoke tests.  ``--bits 8`` streams int8-quantized
+Presets: ``gpt2-xl`` is the offload-parity geometry (2.1B) — pass it
+explicitly on rigs with direct host links; TPU defaults to ``small``
+(~0.53 GB; the tunneled dev rig's host link makes bigger streams
+impractically slow), CPU to ``tiny``.  ``--bits 8`` streams int8-quantized
 weights (4x less traffic — compose quantization with streaming).
 
 Transport caveat: on a *tunneled* TPU (axon dev rig) host→HBM transfers run
@@ -69,12 +71,14 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--task", choices=["decode", "prefill"], default="decode")
     parser.add_argument("--preset", choices=list(presets), default=None,
-                        help="default: gpt2-xl on TPU, tiny elsewhere")
+                        help="default: small on TPU, tiny elsewhere (gpt2-xl = parity geometry)")
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=512,
                         help="prefill length (decode task: prompt length = seq)")
-    parser.add_argument("--new_tokens", type=int, default=8,
-                        help="decode task: timed generated tokens")
+    parser.add_argument("--new_tokens", type=int, default=4,
+                        help="decode task: timed generated tokens (each token "
+                             "streams the full weight set; size the count to "
+                             "the host link)")
     parser.add_argument("--iters", type=int, default=4)
     parser.add_argument("--bits", type=int, choices=[8, 4], default=None,
                         help="stream int-quantized weights")
@@ -86,7 +90,12 @@ def main():
     from accelerate_tpu.models.transformer import Transformer, TransformerConfig
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    preset = args.preset or ("gpt2-xl" if on_tpu else "tiny")
+    # Default: "small" (~0.53 GB) even on TPU — through the tunneled transport a
+    # single gpt2-xl (4.25 GB) weight stream plus its ~14 remote stage
+    # compiles exceeds half an hour, which no bench budget survives.  The
+    # measured metric (stream GB/s, s/token) is model-size-normalized; run
+    # `--preset gpt2-xl` explicitly on rigs with direct PCIe/DMA host links.
+    preset = args.preset or ("small" if on_tpu else "tiny")
     cfg = presets[preset](dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
     seq = min(args.seq, cfg.max_seq_len)
     model = Transformer(cfg)
