@@ -1026,7 +1026,9 @@ class Accelerator:
         # the accumulation buffer and any cross-step traffic under bf16.  Note
         # the in-step cross-replica reduction itself rides the *compute* dtype
         # (XLA reduce-scatters the bf16 dot-transpose partials under a bf16
-        # policy before this cast); averaging/clipping/update stay fp32.
+        # policy before this cast); norm/clip math stays fp32, and the
+        # in-graph optimizer apply upcasts the carry (master mode upcasts
+        # inside the chunk update against fp32 masters instead).
         reduce_dtype = jnp.float32
         master_active = bool(getattr(self, "_offload_master", False))
         if master_active:
