@@ -42,6 +42,7 @@ import gc
 import inspect
 import math
 import os
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
@@ -563,12 +564,29 @@ class Accelerator:
         self._offload_master = False
         use_master = False
         fsdp_plugin = self.effective_fsdp_plugin
+        if fsdp_plugin is not None and fsdp_plugin.offload_optimizer_nvme_path and (
+            not fsdp_plugin.offload_optimizer
+            or fsdp_plugin.offload_update_chunk_mb == 0
+        ):
+            # the disk tier only exists inside the chunked update — silently
+            # keeping the state in HBM would defeat the request at exactly the
+            # bigger-than-HBM scale it targets
+            raise ValueError(
+                "offload_optimizer_nvme_path requires offload_optimizer=True and "
+                "a non-zero offload_update_chunk_mb: the nvme tier streams the "
+                "optimizer state through the chunked update "
+                "(utils/chunked_update.py)."
+            )
         if (
             fsdp_plugin is not None
             and fsdp_plugin.offload_optimizer
-            and fsdp_plugin.offload_update_chunk_mb > 0
+            and fsdp_plugin.offload_update_chunk_mb != 0
         ):
-            from .utils.chunked_update import build_chunked_tx, with_master_weights
+            from .utils.chunked_update import (
+                auto_chunk_bytes,
+                build_chunked_tx,
+                with_master_weights,
+            )
 
             use_master = fsdp_plugin.offload_master_weights
             if use_master is None:
@@ -583,12 +601,66 @@ class Accelerator:
                 tx = with_master_weights(tx, master_dtype=self.policy.param_dtype)
             self._offload_master = bool(use_master)
 
-            tx, info = build_chunked_tx(
-                tx, params, fsdp_plugin.offload_update_chunk_mb * 2**20
-            )
+            overlap = max(int(fsdp_plugin.offload_update_overlap), 1)
+            if fsdp_plugin.offload_update_chunk_mb < -1:
+                raise ValueError(
+                    f"offload_update_chunk_mb={fsdp_plugin.offload_update_chunk_mb}: "
+                    "use a positive size in MB, 0 to disable chunking, or -1 for "
+                    "adaptive sizing from free HBM."
+                )
+            if fsdp_plugin.offload_update_chunk_mb == -1:
+                # adaptive: fill the HBM headroom left by the per-device
+                # resident set (working params + grads [+ accum buffer], each
+                # sharded over fsdp) across the in-flight chunk window
+                working_b = jnp.dtype(
+                    self.policy.compute_dtype if use_master else self.policy.param_dtype
+                ).itemsize
+                grad_b = jnp.dtype(
+                    self.policy.compute_dtype if use_master else jnp.float32
+                ).itemsize
+                accum_b = grad_b if self.gradient_accumulation_steps > 1 else 0
+                chunk_bytes = auto_chunk_bytes(
+                    params,
+                    working_bytes_per_element=working_b,
+                    grad_bytes_per_element=grad_b,
+                    accum_buffer_bytes_per_element=accum_b,
+                    shard_degree=mesh_lib.mesh_axis_size(self.mesh, "fsdp"),
+                    overlap=overlap,
+                )
+                logger.info(
+                    f"offload_update_chunk_mb=auto resolved to {chunk_bytes >> 20} MB "
+                    f"(overlap={overlap})"
+                )
+            else:
+                chunk_bytes = fsdp_plugin.offload_update_chunk_mb * 2**20
+
+            tx, info = build_chunked_tx(tx, params, chunk_bytes)
+            nvme_path = fsdp_plugin.offload_optimizer_nvme_path
+            if info is None and nvme_path:
+                from .utils.chunked_update import _BYTES_PER_ELEMENT
+
+                state_mb = (
+                    sum(
+                        int(math.prod(getattr(l, "shape", ()) or (1,)))
+                        for l in jax.tree_util.tree_leaves(params)
+                    )
+                    * _BYTES_PER_ELEMENT
+                ) >> 20
+                raise ValueError(
+                    "offload_optimizer_device='nvme' streams the optimizer state "
+                    "through bounded chunks, but offload_update_chunk_mb resolves "
+                    f"to a single chunk for this model (~{state_mb} MB of state). "
+                    f"Set offload_update_chunk_mb below {max(state_mb // 2, 1)} to "
+                    "engage the disk tier."
+                )
             if info is not None:
                 info["master"] = bool(use_master)
                 info["params_treedef"] = jax.tree_util.tree_structure(params)
+                info["overlap"] = overlap
+                if nvme_path:
+                    from .utils.chunked_update import DiskChunkStore
+
+                    info["disk_store"] = DiskChunkStore(nvme_path)
                 self._chunk_info = info
 
         grad_accum_dtype = None
@@ -667,6 +739,7 @@ class Accelerator:
         from jax.tree_util import tree_flatten, tree_unflatten
 
         info = self._chunk_info
+        disk_store = info.get("disk_store")
 
         def base_fn(p):
             from jax.memory import Space
@@ -677,7 +750,7 @@ class Accelerator:
             return init_fn(p).replace(opt_state=())
 
         base_shardings = self._train_state_shardings(jax.eval_shape(base_fn, params))
-        base = self._place_with_offload(base_fn, params, base_shardings)
+        base = self._place_with_offload(base_fn, params, base_shardings, clear_after=True)
 
         opt_abstract = abstract.opt_state
         opt_shardings = shardings.opt_state
@@ -703,14 +776,23 @@ class Accelerator:
                 return masked.init(tree_unflatten(view_treedef, full_v))
 
             chunk_leaves = [p_leaves[j] for j in orig_ids]
-            placed = jax.jit(chunk_init, out_shardings=opt_shardings[i])(chunk_leaves)
-            # serialize chunk inits: their stream buffers must not coexist
-            jax.tree_util.tree_map(
-                lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x,
-                placed,
-            )
+            jitted_init = jax.jit(chunk_init, out_shardings=opt_shardings[i])
+            placed = jitted_init(chunk_leaves)
+            if disk_store is not None:
+                # nvme tier: persist the freshly initialized chunk to disk and
+                # keep only the mmap views in the train state (device_get
+                # inside write_chunk doubles as the serialization barrier)
+                placed = disk_store.write_chunk(i, placed)
+            else:
+                # serialize chunk inits: their stream buffers must not coexist
+                jax.tree_util.tree_map(
+                    lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x,
+                    placed,
+                )
+            # evict just this init program's executable (its HBM plan is
+            # chunk-sized but there are many chunks; see _place_with_offload)
+            jitted_init.clear_cache()
             opt_states.append(placed)
-        jax.clear_caches()  # drop the init executables' HBM plans (see _place_with_offload)
         return base.replace(opt_state=tuple(opt_states))
 
     def _train_state_shardings(self, abstract_state):
@@ -824,19 +906,21 @@ class Accelerator:
         )
         if has_host:
             try:
-                placed = jax.jit(init_fn, out_shardings=shardings)(operand)
+                jitted = jax.jit(init_fn, out_shardings=shardings)
+                placed = jitted(operand)
                 if clear_after:
                     # Loaded executables keep their HBM allocation plans
                     # reserved (init programs are state-sized); for a
                     # bigger-than-HBM state those reservations crowd out the
-                    # train step's compile.  Only at creation time — clearing
-                    # here on the generic reshard path would silently drop the
-                    # user's already-compiled steps.
+                    # train step's compile.  The eviction is scoped to THIS
+                    # init program's cache (jitted.clear_cache()) — a global
+                    # jax.clear_caches() would silently invalidate any steps
+                    # the user compiled before creating a second state.
                     jax.tree_util.tree_map(
                         lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x,
                         placed,
                     )
-                    jax.clear_caches()
+                    jitted.clear_cache()
                 return placed
             except (ValueError, NotImplementedError) as e:  # older runtimes
                 logger.warning_once(
@@ -891,17 +975,25 @@ class Accelerator:
 
     # ------------------------------------------------------------- step build
     def _offload_flags(self, warn: bool = False):
-        """(offload_params, offload_opt) per the active plugin and backend support."""
+        """(offload_params, offload_opt) per the active plugin and backend support.
+
+        ``offload_opt`` means *pinned-host* residency; the nvme tier keeps the
+        state on disk instead (chunk programs see plain device arguments fed
+        from mmaps), so it reports False here and works on any backend.
+        """
         plugin = self.effective_fsdp_plugin
         from .parallel.sharding import supports_host_offload
 
         offloading_ok = supports_host_offload(self.mesh)
-        offload_opt = plugin is not None and plugin.offload_optimizer and offloading_ok
+        on_disk = plugin is not None and bool(plugin.offload_optimizer_nvme_path)
+        offload_opt = (
+            plugin is not None and plugin.offload_optimizer and offloading_ok and not on_disk
+        )
         offload_params = plugin is not None and plugin.cpu_offload and offloading_ok
         if (
             warn
             and plugin is not None
-            and (plugin.offload_optimizer or plugin.cpu_offload)
+            and ((plugin.offload_optimizer and not on_disk) or plugin.cpu_offload)
             and not offloading_ok
         ):
             import warnings
@@ -931,6 +1023,12 @@ class Accelerator:
             "dots_saveable": jax.checkpoint_policies.dots_saveable,
             "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             "everything_saveable": jax.checkpoint_policies.everything_saveable,
+            # save the model's checkpoint_name-tagged projection outputs and
+            # recompute the rest (models/transformer.py tags q/k/v/o/gate/down
+            # as "proj_out"; up_proj is tagged "proj_wide" and deliberately
+            # recomputed — see _REMAT_POLICIES there; custom models can tag
+            # their own)
+            "proj_saveable": jax.checkpoint_policies.save_only_these_names("proj_out"),
         }
         if name not in policies:
             raise ValueError(
@@ -1037,27 +1135,30 @@ class Accelerator:
             # grad buffer and stream traffic.  Applies with or without
             # chunking: create_train_state sized grad_accum to match.
             reduce_dtype = policy.compute_dtype
-        if self.collective_handler and self.collective_handler.grad_reduce_dtype:
-            if accum > 1:
-                from .utils.dataclasses import TENSOR_DTYPES
+        explicit_wire = bool(
+            self.collective_handler and self.collective_handler.grad_reduce_dtype
+        )
+        if explicit_wire:
+            # With accumulation this sets the buffer dtype; without, it still
+            # sets the dtype the gradient TREE materializes in between the
+            # backward and the optimizer apply — at 1B params the fp32 default
+            # is a 4 GB live set during clipping, halved under bf16.  Norm and
+            # clip math stay fp32 (global_norm upcasts per-leaf, fused).
+            from .utils.dataclasses import TENSOR_DTYPES
 
-                reduce_dtype = TENSOR_DTYPES[self.collective_handler.grad_reduce_dtype]
-            else:
-                import warnings
+            reduce_dtype = TENSOR_DTYPES[self.collective_handler.grad_reduce_dtype]
 
-                warnings.warn(
-                    "CollectiveKwargs.grad_reduce_dtype sets the gradient "
-                    "accumulation-buffer dtype; with gradient_accumulation_steps=1 "
-                    "there is no buffer to cast (the in-step reduction already runs "
-                    "in the compute dtype), so it is ignored.",
-                    stacklevel=2,
-                )
-
+        # Chunk applies manage their own donation (make_chunk_apply excludes
+        # host-resident args itself), so capture the user's intent BEFORE the
+        # offload override: the wrapper replaces state.params with the chunk
+        # outputs, so donating the device-resident inputs is safe and saves a
+        # params-sized transient per chunk on exactly the bigger-than-HBM
+        # configs this path exists for.
+        user_donate = donate
         offload_params, offload_opt = self._offload_flags(warn=True)
         if offload_opt or offload_params:
             donate = False  # donation of host-resident buffers is rejected by XLA
 
-        user_donate = donate
         if chunked:
             # the wrapper re-wraps the INPUT param buffers into the next state
             # (params never round-trip the grad program); donation would free them
@@ -1191,9 +1292,13 @@ class Accelerator:
                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                 scale_factor = scale_factor * clip
             # Offloaded-master updates upcast against fp32 masters, so their
-            # wire rides reduce_dtype; the plain in-graph apply keeps the
-            # documented fp32 avg — a bf16/fp16 carry buffer upcasts here.
-            avg_dtype = reduce_dtype if (chunked or master_active) else jnp.float32
+            # wire rides reduce_dtype; an EXPLICIT grad_reduce_dtype keeps the
+            # whole carry in the wire dtype (the optimizer apply upcasts
+            # per-leaf against its fp32 state).  Otherwise the plain in-graph
+            # apply keeps the documented fp32 avg.
+            avg_dtype = (
+                reduce_dtype if (chunked or master_active or explicit_wire) else jnp.float32
+            )
             avg = jax.tree_util.tree_map(
                 lambda g: (g.astype(jnp.float32) * scale_factor).astype(avg_dtype), acc
             )
@@ -1307,6 +1412,9 @@ class Accelerator:
         else:
             jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
 
+        # python mirror of the chunked path's micro-step counter (see above)
+        _micro_mirror: Dict[str, Any] = {"ref": None, "micro": 0}
+
         @functools.wraps(loss_fn)
         def step(state, batch):
             gs = self.gradient_state
@@ -1323,11 +1431,20 @@ class Accelerator:
                         "the most recent create_train_state — recompile the step "
                         "after creating each offloaded train state."
                     )
-                # Sync-ness derives from the STATE's counter (one scalar D2H),
-                # not a python mirror: the specialized micro/sync programs and
-                # checkpoint restores stay aligned by construction.
+                # Sync-ness derives from the state's micro-step counter, but a
+                # D2H read every call would serialize the whole pipeline (async
+                # dispatch lost for the full training loop, not just sync
+                # steps).  A python mirror tracks the counter for states THIS
+                # step emitted (identity-checked via weakref); the device value
+                # is read only on re-alignment — first call, checkpoint
+                # restore, or a state from elsewhere.
                 if accum > 1:
-                    synced = force or (int(jax.device_get(state.micro_step)) + 1 >= accum)
+                    known = _micro_mirror.get("ref")
+                    if known is not None and known() is state:
+                        micro = _micro_mirror["micro"]
+                    else:
+                        micro = int(jax.device_get(state.micro_step))
+                    synced = force or (micro + 1 >= accum)
                 else:
                     synced = True
                 small, metrics, avg = jitted(state, batch, synced)
@@ -1363,6 +1480,9 @@ class Accelerator:
                                 donate_argnums=(0,),
                             )
                         new_state = new_state.replace(grad_accum=zfn(avg))
+                if accum > 1:
+                    _micro_mirror["micro"] = 0 if synced else micro + 1
+                    _micro_mirror["ref"] = weakref.ref(new_state)
                 self._track_state(new_state)
                 gs._set_sync_gradients(synced)
                 return new_state, metrics
@@ -1403,6 +1523,7 @@ class Accelerator:
         """
         from .utils.chunked_update import make_chunk_apply
 
+        disk = info.get("disk_store")
         key = ("fns", opt_on_host, params_on_host, donate)
         fns = info.get(key)
         if fns is None:
@@ -1410,7 +1531,7 @@ class Accelerator:
                 make_chunk_apply(
                     group, masked, info,
                     opt_on_host=opt_on_host, params_on_host=params_on_host,
-                    donate=donate,
+                    donate=donate, opt_on_disk=disk is not None,
                 )
                 for group, masked in zip(info["groups"], info["masked"])
             ]
@@ -1418,16 +1539,48 @@ class Accelerator:
         g_leaves = jax.tree_util.tree_flatten(avg)[0]
         opt_states = list(state.opt_state)
         new_p = list(p_leaves)
+        # Bounded in-flight window: the chunk programs are mutually independent
+        # (data deps between chunks sharing a sliced leaf are tracked by the
+        # arrays themselves), so unbounded async dispatch would let ALL their
+        # stream buffers coexist in HBM — the O(opt state) peak this path
+        # exists to avoid.  A window of `overlap` (default 2, the
+        # double-buffer) overlaps chunk N's host write-back with chunk N+1's
+        # host read at peak = overlap * chunk transients.
+        overlap = max(int(info.get("overlap", 1)), 1)
+
+        def _drain(entry):
+            i, outputs = entry
+            if disk is not None:
+                # nvme tier: persist the updated subtree (device_get inside
+                # write_chunk doubles as the completion barrier) and swap the
+                # mmap views back into the state
+                opt_states[i] = disk.write_chunk(i, opt_states[i])
+                return
+            # A chunk output can be donated to a LATER chunk before we block on
+            # it (a sliced leaf spanning two chunks): skip deleted buffers —
+            # the consuming program's own completion handle covers them.
+            for x in outputs:
+                if isinstance(x, jax.Array) and not x.is_deleted():
+                    x.block_until_ready()
+                    return
+
+        inflight: List[Any] = []
         for i, (fn, orig_ids) in enumerate(fns):
+            if len(inflight) >= overlap:
+                _drain(inflight.pop(0))
             chunk_p = [new_p[j] for j in orig_ids]
             chunk_g = [g_leaves[j] for j in orig_ids]
             new_chunk_p, opt_states[i] = fn(chunk_p, chunk_g, opt_states[i])
-            # Barrier per chunk: the chunk programs are mutually independent, so
-            # async dispatch would let all their stream buffers coexist in HBM —
-            # exactly the O(opt state) peak this path exists to avoid.
-            new_chunk_p[0].block_until_ready()
+            # completion handles: prefer the new opt-state leaves (never fed to
+            # a later chunk in this loop), fall back to the param outputs (an
+            # empty-state tx like sgd has no opt arrays)
+            inflight.append(
+                (i, jax.tree_util.tree_leaves(opt_states[i]) + list(new_chunk_p))
+            )
             for pos, j in enumerate(orig_ids):
                 new_p[j] = new_chunk_p[pos]
+        while inflight:
+            _drain(inflight.pop(0))
         return state.replace(
             params=jax.tree_util.tree_unflatten(p_def, new_p),
             opt_state=tuple(opt_states),

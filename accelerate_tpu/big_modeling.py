@@ -79,13 +79,15 @@ def init_params_on_host(model, *args, method: str = "init", rng=None, **kwargs):
         return jax.jit(run)()
     host = NamedSharding(mesh, PartitionSpec(), memory_kind="pinned_host")
     shapes = jax.eval_shape(run)
-    placed = jax.jit(
-        run, out_shardings=jax.tree_util.tree_map(lambda _: host, shapes)
-    )()
+    jitted = jax.jit(run, out_shardings=jax.tree_util.tree_map(lambda _: host, shapes))
+    placed = jitted()
     jax.tree_util.tree_map(
         lambda x: x.block_until_ready() if isinstance(x, jax.Array) else x, placed
     )
-    jax.clear_caches()  # drop the init executable's HBM plan before training compiles
+    # drop the init executable's HBM plan before training compiles — scoped to
+    # this program only (a global clear_caches would invalidate any steps the
+    # caller already compiled)
+    jitted.clear_cache()
     return placed
 
 

@@ -54,13 +54,37 @@ _REMAT_POLICIES = {
     "nothing_saveable": "nothing_saveable",
     "dots_saveable": "dots_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    # Save the per-layer projection outputs (q/k/v/o/gate/down, tagged
+    # "proj_out" below) and recompute only the attention block and the
+    # up_proj matmul in the backward.  This is the policy "dots_saveable"
+    # *should* be on a transformer whose attention materializes [S, S] scores
+    # (the XLA path): dots_saveable would save the S^2 logits — ~1 GB/layer
+    # at seq 2048 — while full remat recomputes every matmul.  up_proj is
+    # tagged "proj_wide" and excluded: its save is inter-sized (the largest,
+    # tied with gate) while costing the same recompute FLOPs per byte as any
+    # other matmul, and dropping exactly one wide save is what lets the
+    # policy fit next to a full fp32 adam state on 16 GB chips.
+    "proj_saveable": "proj_saveable",
 }
 
 
 def _remat_policy(cfg):
     """Resolve ``TransformerConfig.remat_policy`` to a jax checkpoint policy."""
     name = _REMAT_POLICIES[cfg.remat_policy]
-    return None if name is None else getattr(jax.checkpoint_policies, name)
+    if name is None:
+        return None
+    if name == "proj_saveable":
+        return jax.checkpoint_policies.save_only_these_names("proj_out")
+    return getattr(jax.checkpoint_policies, name)
+
+
+def _tag_proj(x, name: str = "proj_out"):
+    """Mark a projection output saveable under remat_policy="proj_saveable"
+    (identity otherwise).  ``name="proj_wide"`` marks it recompute-instead
+    (see _REMAT_POLICIES)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(x, name)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,7 +108,7 @@ class TransformerConfig:
     # ~1 extra activation set per layer — the usual MFU/memory middle ground)
     remat_policy: str = "full"
     scan_layers: bool = False          # roll layers into lax.scan
-    attention_impl: str = "xla"        # "xla" | "pallas" | "ring" (sp-axis sequence parallel)
+    attention_impl: str = "xla"        # "xla" | "blocked" | "pallas" | "ring" (sp sequence parallel)
     ring_attention_layout: str = "contiguous"  # "contiguous" | "zigzag" (balanced causal ring)
     dropout_rate: float = 0.0
     # fp8 matmuls (TransformerEngine analog, ops/fp8.py): projection/MLP dots
@@ -253,9 +277,9 @@ class Attention(nn.Module):
         cfg = self.config
         hd = cfg.resolved_head_dim
         dense = functools_partial_dense(cfg)
-        q = dense("q_proj", cfg.num_heads * hd)(x)
-        k = dense("k_proj", cfg.num_kv_heads * hd)(x)
-        v = dense("v_proj", cfg.num_kv_heads * hd)(x)
+        q = _tag_proj(dense("q_proj", cfg.num_heads * hd)(x))
+        k = _tag_proj(dense("k_proj", cfg.num_kv_heads * hd)(x))
+        v = _tag_proj(dense("v_proj", cfg.num_kv_heads * hd)(x))
         b, s = x.shape[:2]
         q = q.reshape(b, s, cfg.num_heads, hd)
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
@@ -278,7 +302,7 @@ class Attention(nn.Module):
             segment_ids=segment_ids, ring_layout=cfg.ring_attention_layout
         )
         out = out.reshape(b, s, cfg.num_heads * hd)
-        return dense("o_proj", cfg.hidden_size)(out)
+        return _tag_proj(dense("o_proj", cfg.hidden_size)(out))
 
 
 def functools_partial_dense(cfg: TransformerConfig):
@@ -332,9 +356,9 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = functools_partial_dense(cfg)
-        gate = dense("gate_proj", cfg.intermediate_size)(x)
-        up = dense("up_proj", cfg.intermediate_size)(x)
-        return dense("down_proj", cfg.hidden_size)(nn.silu(gate) * up)
+        gate = _tag_proj(dense("gate_proj", cfg.intermediate_size)(x))
+        up = _tag_proj(dense("up_proj", cfg.intermediate_size)(x), "proj_wide")
+        return _tag_proj(dense("down_proj", cfg.hidden_size)(nn.silu(gate) * up))
 
 
 class DecoderLayer(nn.Module):

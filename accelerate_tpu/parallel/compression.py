@@ -148,7 +148,9 @@ def compression_stats(params: Any, comm_state: Any) -> Dict[str, float]:
     compressed = 0
     for p, st in zip(
         jax.tree_util.tree_leaves(params),
-        jax.tree_util.tree_flatten(comm_state, is_leaf=lambda x: x is None or "q" in x)[0]
+        jax.tree_util.tree_flatten(
+            comm_state, is_leaf=lambda x: x is None or (isinstance(x, dict) and "q" in x)
+        )[0]
         if comm_state is not None
         else [None] * len(jax.tree_util.tree_leaves(params)),
     ):

@@ -116,7 +116,14 @@ def make_opt_sharding_fn(
     fsdp_size = mesh_lib.mesh_axis_size(mesh, "fsdp")
     shards_opt = plugin is not None and plugin.shards_opt_state and fsdp_size > 1
     min_size = plugin.min_weight_size if plugin is not None else 2**12
-    memory_kind = "pinned_host" if (plugin is not None and plugin.offload_optimizer) else None
+    # the nvme tier keeps opt state on DISK (utils/chunked_update.DiskChunkStore),
+    # not pinned host memory — chunk programs get plain device placements
+    on_disk = plugin is not None and getattr(plugin, "offload_optimizer_nvme_path", None)
+    memory_kind = (
+        "pinned_host"
+        if (plugin is not None and plugin.offload_optimizer and not on_disk)
+        else None
+    )
     if memory_kind is not None and not supports_host_offload(mesh):
         memory_kind = None
 

@@ -36,15 +36,87 @@ ZeRO-Offload memory story: device peak = bf16 params + bf16 grads + O(chunk).
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 # Per parameter element the streamed chunk holds master + two fp32 moments
 # plus the transient update — budget 12 bytes/element when sizing groups.
 _BYTES_PER_ELEMENT = 12
+
+# Measured per-chunk HBM transient relative to the chunk's 12 B/element state:
+# in + out stream copies plus the adam temps run ~4x the chunk footprint
+# (BENCH_NOTES.md: 1 GB chunks reliable next to an 8.5 GB resident set on a
+# 16 GB chip; 2 GB chunks OOM intermittently).
+_CHUNK_TRANSIENT_FACTOR = 4
+
+# Conservative per-chip HBM capacities (bytes) by device_kind prefix, for
+# runtimes without memory_stats() (axon tunnels return None).  Public specs.
+_HBM_BY_DEVICE_KIND = {
+    "TPU v6": 32 << 30,
+    "TPU v5p": 95 << 30,
+    "TPU v5 lite": 16 << 30,
+    "TPU v5e": 16 << 30,
+    "TPU v4": 32 << 30,
+    "TPU v3": 16 << 30,
+}
+
+
+def detect_hbm_bytes(device=None) -> int:
+    """Per-device memory capacity: ``memory_stats()['bytes_limit']`` where the
+    runtime provides it, else a spec-sheet table by device kind, else a
+    conservative 16 GB."""
+    device = device if device is not None else jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix, size in _HBM_BY_DEVICE_KIND.items():
+        if kind.lower().startswith(prefix.lower()):
+            return size
+    return 16 << 30
+
+
+def auto_chunk_bytes(
+    params: Any,
+    *,
+    working_bytes_per_element: int,
+    grad_bytes_per_element: int,
+    accum_buffer_bytes_per_element: int = 0,
+    shard_degree: int = 1,
+    overlap: int = 2,
+    hbm_bytes: Optional[int] = None,
+) -> int:
+    """Pick the streamed-chunk size from measured free HBM.
+
+    Per device the resident set is the working params + grad buffer (+ the
+    separate accumulation buffer when used), each divided by ``shard_degree``
+    (the fsdp axis shards all three).  What remains after a margin for
+    activations/executables is split across ``overlap`` in-flight chunks, each
+    costing ~``_CHUNK_TRANSIENT_FACTOR`` x its state footprint.  Returns
+    GLOBAL chunk bytes (the 12 B/element grouping unit of
+    :func:`build_chunked_tx` — sharded leaves stream only their local shard,
+    so the per-device cost is chunk/shard_degree).
+    """
+    hbm = hbm_bytes if hbm_bytes is not None else detect_hbm_bytes()
+    n_elements = sum(
+        int(math.prod(getattr(l, "shape", ()) or (1,)))
+        for l in jax.tree_util.tree_leaves(params)
+    )
+    per_el = working_bytes_per_element + grad_bytes_per_element + accum_buffer_bytes_per_element
+    resident = n_elements * per_el // max(shard_degree, 1)
+    margin = max(1 << 30, int(hbm * 0.10))  # activations + executables + fragmentation
+    free = hbm - resident - margin
+    per_dev_chunk = free // (_CHUNK_TRANSIENT_FACTOR * max(overlap, 1))
+    chunk = per_dev_chunk * max(shard_degree, 1)
+    return int(min(max(chunk, 64 << 20), 4 << 30))
 
 
 def with_master_weights(
@@ -270,6 +342,7 @@ def make_chunk_apply(
     opt_on_host: bool,
     params_on_host: bool = False,
     donate: bool = True,
+    opt_on_disk: bool = False,
 ):
     """Jitted per-chunk apply over FULL leaves: ``(chunk_leaves, chunk_grads,
     chunk_opt_state) -> (new_chunk_leaves, new_chunk_opt_state)``.
@@ -281,7 +354,10 @@ def make_chunk_apply(
     outside the chunk's view positions are fed to ``optax.masked`` as
     shape-() dummies (it replaces them with ``MaskedNode`` pre-update, so
     only this chunk's tensors materialize).  Host-resident arguments are NOT
-    donated (XLA rejects host-buffer donation).
+    donated (XLA rejects host-buffer donation); disk-resident opt state
+    (``opt_on_disk``, the nvme tier) arrives as numpy mmaps — uploaded H2D at
+    dispatch, not donatable — and the updated subtree is returned on device
+    for the caller to persist (``DiskChunkStore.write_chunk``).
     """
     meta = info["meta"]
     view_treedef = info["view_treedef"]
@@ -322,10 +398,62 @@ def make_chunk_apply(
         return new_leaves, new_state
 
     donate_argnums = tuple(
-        i for i, on_host in ((0, params_on_host), (2, opt_on_host))
-        if donate and not on_host
+        i for i, off_device in ((0, params_on_host), (2, opt_on_host or opt_on_disk))
+        if donate and not off_device
     )
     return jax.jit(fn, donate_argnums=donate_argnums), orig_ids
+
+
+# ------------------------------------------------------------ NVMe tier
+class DiskChunkStore:
+    """Disk ("nvme") tier for the chunked optimizer update — the reference's
+    ``offload_optimizer_device="nvme"`` + ``nvme_path``
+    (``/root/reference/src/accelerate/utils/dataclasses.py:806-834``,
+    DeepSpeed ZeRO-Infinity's optimizer tier).
+
+    Each chunk's optimizer subtree lives in raw ``.dat`` files under
+    ``path/chunk_<i>/`` (the :mod:`accelerate_tpu.utils.offload` format,
+    bf16 stored as int16), memory-mapped read-only between sync steps.  The
+    chunk apply consumes the mmaps directly — the H2D upload reads straight
+    from page cache/disk, and on rigs with the native runtime the same files
+    are eligible for ``atpu_runtime.read_blocks`` threaded preads — and the
+    updated subtree is written back through a fresh ``w+`` map after the
+    program completes.  RAM and HBM stay bounded at O(chunk); the full state
+    lives only on disk.
+    """
+
+    def __init__(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self._meta: Dict[int, Any] = {}  # chunk -> (treedef, [leaf infos])
+
+    def _chunk_dir(self, i: int) -> str:
+        d = os.path.join(self.path, f"chunk_{i}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def write_chunk(self, i: int, subtree: Any) -> Any:
+        """Persist a (device/host) chunk subtree; return it re-mapped from disk."""
+        from .offload import offload_weight
+
+        leaves, treedef = jax.tree_util.tree_flatten(subtree)
+        d = self._chunk_dir(i)
+        index: Dict[str, Dict] = {}
+        for j, leaf in enumerate(leaves):
+            offload_weight(np.asarray(leaf), f"leaf_{j}", d, index=index)
+        self._meta[i] = (treedef, [index[f"leaf_{j}"] for j in range(len(leaves))])
+        return self.read_chunk(i)
+
+    def read_chunk(self, i: int) -> Any:
+        from .offload import load_offloaded_weight
+
+        treedef, infos = self._meta[i]
+        d = self._chunk_dir(i)
+        leaves = [
+            load_offloaded_weight(os.path.join(d, f"leaf_{j}.dat"), info)
+            for j, info in enumerate(infos)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 # Back-compat helpers used by tests

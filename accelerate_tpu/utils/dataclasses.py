@@ -178,10 +178,13 @@ class CollectiveKwargs(KwargsHandler):
 
     - ``grad_reduce_dtype`` — gradient *carry* dtype (the comm-hook fp16/bf16
       compression analog): grads are cast to it right after backward, so the
-      accumulation buffer and cross-step traffic halve under bf16.  The in-step
-      cross-replica reduction itself runs in the compute dtype (XLA reduces the
-      bf16 dot-transpose partials under a bf16 policy).  Only meaningful with
-      gradient_accumulation_steps > 1.
+      accumulation buffer, the live gradient tree between backward and
+      optimizer apply, and cross-step traffic all halve under bf16.  With
+      ``gradient_accumulation_steps == 1`` this is a deliberate
+      precision/memory trade: the optimizer consumes the narrowed grads
+      (clip/norm math stays fp32, as does the adam state).  The in-step
+      cross-replica reduction itself runs in the compute dtype (XLA reduces
+      the bf16 dot-transpose partials under a bf16 policy).
     - ``comm_hook="powersgd"`` — low-rank gradient compression over the ``dp``
       axis (reference ``DDPCommunicationHookType.POWER_SGD``,
       ``utils/dataclasses.py:105-199``): the backward runs per-replica under
@@ -333,7 +336,22 @@ class FullyShardedDataParallelPlugin:
     # round-trip HBM in ~this many MB per jitted chunk on sync steps
     # (utils/chunked_update.py — the DeepSpeedCPUAdam-parity piece).  0 restores
     # the whole-state round-trip (only viable when opt state fits HBM spare).
+    # -1 picks the size adaptively from free HBM (device memory_stats where
+    # available, a conservative per-chip table otherwise) so the streamed
+    # window fills the headroom left by params+grads without OOMing.
     offload_update_chunk_mb: int = 512
+    # In-flight window for the chunked update: how many chunk programs may be
+    # dispatched before blocking on the oldest.  2 (double-buffer) overlaps
+    # chunk N's host write-back with chunk N+1's host read at peak HBM =
+    # overlap * chunk transients; 1 restores the fully serialized update.
+    offload_update_overlap: int = 2
+    # Disk ("nvme") tier for the offloaded optimizer state: when set (and
+    # offload_optimizer is on), the chunked update's source is mmap'd .dat
+    # files under this path instead of pinned host memory
+    # (utils/chunked_update.DiskChunkStore — the DeepSpeed ZeRO-Infinity
+    # nvme_path analog).  Works on any backend (no host-memory support
+    # needed); RAM and HBM stay O(chunk).
+    offload_optimizer_nvme_path: Optional[str] = None
     # ZeRO-Offload weight layout: keep fp32 master weights inside the
     # (host-offloaded) optimizer state and store TrainState.params in the
     # compute dtype — DeepSpeed's exact split (fp32 masters + moments on host,
@@ -408,16 +426,24 @@ class ZeroPlugin:
     zero_stage: int = 2
     gradient_accumulation_steps: Optional[int] = None
     gradient_clipping: Optional[float] = None
-    offload_optimizer_device: str = "none"   # "none" | "cpu"
-    offload_param_device: str = "none"
+    offload_optimizer_device: str = "none"   # "none" | "cpu" | "nvme"
+    offload_param_device: str = "none"       # "none" | "cpu"
+    # Directory for the "nvme" optimizer tier (reference DeepSpeedPlugin
+    # offload_optimizer_nvme_path, utils/dataclasses.py:806-834): the chunked
+    # update streams moments/masters from mmap'd files here instead of pinned
+    # host memory.
+    nvme_path: Optional[str] = None
     # Save fp32 master weights as bf16 in save_model (the reference's
     # zero3_save_16bit_model, DeepSpeedPlugin stage3_gather_16bit_weights).
     zero3_save_16bit_model: bool = False
     train_micro_batch_size_per_gpu: Optional[int] = None
     # Streaming granularity for the host-offloaded update (None = the FSDP
-    # plugin default, 512 MB).  Fewer/bigger chunks = fewer compiled chunk
-    # programs (compile time) at more HBM per stream.
+    # plugin default, 512 MB; -1 = adaptive from free HBM).  Fewer/bigger
+    # chunks = fewer compiled chunk programs (compile time) at more HBM per
+    # stream.
     offload_update_chunk_mb: Optional[int] = None
+    # In-flight chunk window (None = FSDP plugin default, 2 = double-buffer).
+    offload_update_overlap: Optional[int] = None
     # Note: the reference's zero3_init_flag (meta-device init) has no knob here
     # because create_train_state always initializes abstractly (jax.eval_shape +
     # out_shardings) — full state is never materialized on one device.  NVMe
@@ -433,14 +459,24 @@ class ZeroPlugin:
             self.offload_param_device = os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"]
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"ZeRO stage must be 0-3, got {self.zero_stage}")
-        for field_name in ("offload_optimizer_device", "offload_param_device"):
-            device = getattr(self, field_name)
-            if device not in ("none", "cpu"):
-                raise ValueError(
-                    f"{field_name}={device!r} is not supported on the TPU runtime; "
-                    "use 'cpu' (pinned-host offload) or 'none'. Disk-backed weight "
-                    "streaming is available via big_modeling.load_checkpoint_and_dispatch."
-                )
+        if self.offload_optimizer_device not in ("none", "cpu", "nvme"):
+            raise ValueError(
+                f"offload_optimizer_device={self.offload_optimizer_device!r} is not "
+                "supported; use 'cpu' (pinned-host offload), 'nvme' (disk tier, "
+                "requires nvme_path), or 'none'."
+            )
+        if self.offload_optimizer_device == "nvme" and not self.nvme_path:
+            raise ValueError(
+                "offload_optimizer_device='nvme' requires nvme_path (the directory "
+                "the chunked update streams optimizer state from)."
+            )
+        if self.offload_param_device not in ("none", "cpu"):
+            raise ValueError(
+                f"offload_param_device={self.offload_param_device!r} is not supported "
+                "on the TPU runtime; use 'cpu' (pinned-host offload) or 'none'. "
+                "Disk-backed weight streaming is available via "
+                "big_modeling.load_checkpoint_and_dispatch."
+            )
 
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
         """Lower the ZeRO description onto the single sharding mechanism.
@@ -458,11 +494,16 @@ class ZeroPlugin:
         kwargs = {}
         if self.offload_update_chunk_mb is not None:
             kwargs["offload_update_chunk_mb"] = self.offload_update_chunk_mb
+        if self.offload_update_overlap is not None:
+            kwargs["offload_update_overlap"] = self.offload_update_overlap
         return FullyShardedDataParallelPlugin(
             sharding_strategy=strategy,
             min_weight_size=0 if self.zero_stage == 3 else 2**12,
             cpu_offload=self.offload_param_device == "cpu",
-            offload_optimizer=self.offload_optimizer_device == "cpu",
+            offload_optimizer=self.offload_optimizer_device in ("cpu", "nvme"),
+            offload_optimizer_nvme_path=(
+                self.nvme_path if self.offload_optimizer_device == "nvme" else None
+            ),
             shard_gradients=self.zero_stage >= 2,
             **kwargs,
         )

@@ -171,6 +171,9 @@ def _bench_train_config(
             "num_heads": 4,
             "num_kv_heads": 2,
             "max_seq_len": 64,
+            # the pallas kernel interprets on CPU — too slow for even a smoke
+            # run at seq 64; the smoke tier checks the config plumbing only
+            "attention_impl": "xla",
         }
         batch, steps, warmup = 2, 2, 1
     seq = cfg_kwargs["max_seq_len"]
@@ -219,6 +222,8 @@ def _bench_train_config(
         "baseline": baseline_note,
         "final_loss": float(metrics["loss"]),
         "smoke": smoke,
+        "remat_policy": cfg.remat_policy,
+        "attention_impl": cfg.attention_impl,
     }
     if peak is not None:
         detail["chip_peak_tflops"] = peak
@@ -240,7 +245,8 @@ def _bench_train_config(
     )
 
 
-def bench_zero3(smoke: bool = False, batch: int = 4):
+def bench_zero3(smoke: bool = False, batch: int = 4, chunk_mb: int = -1, overlap: int = 2,
+                **cfg_overrides):
     """GPT-2-XL geometry (1.5B), ZeRO-3 + host optimizer offload — the
     BASELINE.md 'DeepSpeed ZeRO-3 plugin equivalent' config.  The fp32 adam
     moments (~12 GB) live in host memory and stream to HBM only on update
@@ -257,17 +263,27 @@ def bench_zero3(smoke: bool = False, batch: int = 4):
             num_heads=25,
             num_kv_heads=25,
             max_seq_len=1024,
+            # full remat stays here: activation savings matter more than
+            # recompute FLOPs when the whole budget is params+grads+chunk
+            # streams, and step time is dominated by the optimizer-state
+            # stream anyway
+            **cfg_overrides,
         ),
         batch=batch,
         accelerator_kwargs=dict(
             deepspeed_plugin=at.ZeroPlugin(
                 zero_stage=3,
                 offload_optimizer_device="cpu",
-                # ~21 chunk programs; transients run ~4x the chunk state
-                # (in+out copies + adam temps).  1 GB chunks leave reliable
-                # headroom next to the params+grads peak; bigger chunks are
-                # marginal on 16 GB and OOM intermittently.
-                offload_update_chunk_mb=1024,
+                # adaptive chunk sizing from free HBM (utils/chunked_update.
+                # auto_chunk_bytes): resident working set + a 10% margin leave
+                # ~6 GB on a 16 GB chip, split across the 2-deep in-flight
+                # window at ~4x transients per chunk => ~700 MB chunks.  The
+                # double-buffer (offload_update_overlap=2, the default)
+                # overlaps chunk N's host write-back with chunk N+1's read —
+                # the round-3 config serialized every chunk behind a 1-2 s
+                # tunnel barrier (46 s/step at 1 GB chunks; BENCH_NOTES.md).
+                offload_update_chunk_mb=chunk_mb,
+                offload_update_overlap=overlap,
             ),
             mesh={"fsdp": -1},
             # NB: accumulation would amortize the per-step optimizer stream,
@@ -281,12 +297,23 @@ def bench_zero3(smoke: bool = False, batch: int = 4):
     )
 
 
-def bench_fsdp(smoke: bool = False, batch: int = 4):
+def bench_fsdp(smoke: bool = False, batch: int = 3, grad_wire: str = "bf16", **cfg_overrides):
     """Llama geometry full-shard FSDP at the largest single-chip-feasible
     scale (TinyLlama-1.1B-class: hidden 2048, GQA 32/4, SwiGLU 5632, seq 2048,
     16 layers ≈ 0.84B so fp32 params+grads+adam ≈ 13.5 GB fit v5e HBM) — the
     BASELINE.md 'Llama-2-7B full-shard FSDP' config scaled to the bench rig;
-    on a pod mesh the same code spans chips."""
+    on a pod mesh the same code spans chips.
+
+    Defaults are the measured-best from the round-4 sweep (BENCH_NOTES.md):
+    batch 3, full remat, XLA attention, bf16 gradient carry.  The step is
+    attention-bandwidth-bound at this seq-2048 geometry: every alternative
+    measured — dots_saveable and proj_saveable remat (less recompute, more
+    HBM traffic), the in-tree pallas flash, splash attention, stock pallas
+    flash, and causal-blocked XLA attention — came out equal or slower on
+    v5e, so the remaining MFU headroom is an attention kernel faster than
+    XLA's fused path, which none of the five candidates is at GQA 32:4 /
+    head-dim 64.  Use --remat-policy/--attention-impl/--grad-wire to
+    reproduce the sweep."""
     import accelerate_tpu as at
 
     _bench_train_config(
@@ -299,11 +326,23 @@ def bench_fsdp(smoke: bool = False, batch: int = 4):
             num_heads=32,
             num_kv_heads=4,
             max_seq_len=2048,
+            # full remat measured FASTER than proj_saveable/dots_saveable here
+            # (saving activations costs more HBM bandwidth than the recompute
+            # costs FLOPs on this attention-bound step) — see BENCH_NOTES.md
+            **{"remat_policy": "full", **cfg_overrides},
         ),
         batch=batch,
         accelerator_kwargs=dict(
             fsdp_plugin=at.FullyShardedDataParallelPlugin(sharding_strategy="FULL_SHARD"),
             mesh={"fsdp": -1},
+            # bf16 gradient carry (the DDP bf16 comm-hook analog, reference
+            # utils/dataclasses.py:105-199): halves the live gradient tree
+            # between backward and apply — ~1.7 GB at this geometry, the
+            # margin that lets proj_saveable fit next to the fp32 adam state.
+            # Clip/norm math stays fp32; moments stay fp32.
+            kwargs_handlers=(
+                [at.CollectiveKwargs(grad_reduce_dtype="bf16")] if grad_wire == "bf16" else []
+            ),
         ),
         baseline_note="BASELINE.md: Llama full-shard FSDP MFU target; vs_baseline reports MFU",
         smoke=smoke,
@@ -368,13 +407,48 @@ def main():
     parser.add_argument("--smoke", action="store_true",
                         help="tiny-geometry run of the same code path (CI)")
     parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--remat-policy", default=None,
+                        choices=["full", "nothing_saveable", "dots_saveable",
+                                 "dots_with_no_batch_dims_saveable", "proj_saveable"],
+                        help="override the task's remat policy (fsdp default: full)")
+    parser.add_argument("--attention-impl", default=None,
+                        choices=["xla", "blocked", "pallas"],
+                        help="override the task's attention kernel (default: xla)")
+    parser.add_argument("--grad-wire", default=None, choices=["bf16", "fp32"],
+                        help="fsdp task: gradient carry dtype (default bf16)")
+    parser.add_argument("--chunk-mb", type=int, default=None,
+                        help="zero3 task: offload chunk size in MB (-1 = adaptive)")
+    parser.add_argument("--overlap", type=int, default=None,
+                        help="zero3 task: in-flight chunk window (1 = serialized)")
     args = parser.parse_args()
+    overrides = {}
+    if args.batch:
+        overrides["batch"] = args.batch
+    if args.remat_policy:
+        overrides["remat_policy"] = args.remat_policy
+    if args.attention_impl:
+        overrides["attention_impl"] = args.attention_impl
+    if args.grad_wire and args.task != "fsdp":
+        parser.error("--grad-wire only applies to --task fsdp")
+    if (args.chunk_mb is not None or args.overlap is not None) and args.task != "zero3":
+        parser.error("--chunk-mb/--overlap only apply to --task zero3")
+    if overrides and args.task in ("lm", "mrpc"):
+        parser.error(
+            f"--batch/--remat-policy/--attention-impl only apply to "
+            f"the zero3/fsdp tasks, not --task {args.task}"
+        )
     if args.task == "mrpc":
         bench_mrpc()
     elif args.task == "zero3":
-        bench_zero3(smoke=args.smoke, **({"batch": args.batch} if args.batch else {}))
+        if args.chunk_mb is not None:
+            overrides["chunk_mb"] = args.chunk_mb
+        if args.overlap is not None:
+            overrides["overlap"] = args.overlap
+        bench_zero3(smoke=args.smoke, **overrides)
     elif args.task == "fsdp":
-        bench_fsdp(smoke=args.smoke, **({"batch": args.batch} if args.batch else {}))
+        if args.grad_wire:
+            overrides["grad_wire"] = args.grad_wire
+        bench_fsdp(smoke=args.smoke, **overrides)
     else:
         bench_lm_proxy()
 

@@ -65,6 +65,61 @@ def test_gqa_forward_and_grads(qkv):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5 * max(scale, 1.0))
 
 
+class TestBlockedCausal:
+    """Causal-blocked XLA-level attention (ops/attention.blocked_causal_attention):
+    skips the masked upper triangle; must match the reference exactly."""
+
+    def test_forward_matches_reference(self, qkv):
+        q, k, v = qkv
+        out = dot_product_attention(q, k, v, causal=True, implementation="blocked")
+        ref = _reference_attention(q, k, v, causal=True, scale=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_gqa_and_grads(self):
+        rng = np.random.default_rng(2)
+        n_kv = 2
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, n_kv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, n_kv, D)), jnp.float32)
+        out = dot_product_attention(q, k, v, causal=True, implementation="blocked")
+        ref = dot_product_attention(q, k, v, causal=True, implementation="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        g1 = jax.grad(
+            lambda *a: (dot_product_attention(*a, causal=True, implementation="blocked") ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda *a: (dot_product_attention(*a, causal=True) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            scale = float(jnp.abs(b).max())
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5 * max(scale, 1.0))
+
+    def test_segments_match_reference(self, qkv):
+        q, k, v = qkv
+        seg = jnp.asarray(
+            np.random.default_rng(0).integers(0, 2, (B, S)).cumsum(axis=1) // 3, jnp.int32
+        )
+        mask = (seg[:, :, None] == seg[:, None, :])[:, None, :, :]
+        out = dot_product_attention(
+            q, k, v, causal=True, implementation="blocked", segment_ids=seg
+        )
+        ref = _reference_attention(q, k, v, causal=True, scale=None, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_non_causal_rejected(self, qkv):
+        q, k, v = qkv
+        with pytest.raises(ValueError, match="causal-only"):
+            dot_product_attention(q, k, v, causal=False, implementation="blocked")
+
+    def test_indivisible_seq_rejected(self, qkv):
+        q, k, v = qkv
+        from accelerate_tpu.ops.attention import blocked_causal_attention
+
+        with pytest.raises(ValueError, match="divisible"):
+            blocked_causal_attention(q[:, :200], k[:, :200], v[:, :200], chunk=128)
+
+
 def test_segment_ids_mask_packed_sequences(qkv):
     q, k, v = qkv
     seg = jnp.concatenate(

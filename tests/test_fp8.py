@@ -261,9 +261,21 @@ class TestGradReduceDtype:
 
 
 class TestZeroKnobs:
-    def test_nvme_rejected_with_guidance(self):
-        with pytest.raises(ValueError, match="not supported on the TPU runtime"):
+    def test_nvme_requires_path(self):
+        with pytest.raises(ValueError, match="requires nvme_path"):
             ZeroPlugin(offload_optimizer_device="nvme")
+
+    def test_nvme_param_offload_rejected_with_guidance(self):
+        with pytest.raises(ValueError, match="not supported on the TPU runtime"):
+            ZeroPlugin(offload_param_device="nvme")
+
+    def test_nvme_lowers_to_fsdp_plugin(self, tmp_path):
+        plugin = ZeroPlugin(
+            zero_stage=3, offload_optimizer_device="nvme", nvme_path=str(tmp_path)
+        )
+        fsdp = plugin.to_fsdp_plugin()
+        assert fsdp.offload_optimizer
+        assert fsdp.offload_optimizer_nvme_path == str(tmp_path)
 
     def test_save_16bit_model(self, tmp_path):
         from safetensors.numpy import load_file
