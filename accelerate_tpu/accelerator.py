@@ -157,18 +157,38 @@ class Accelerator:
         if self.fp8_recipe_handler is None and mixed_precision == "fp8":
             self.fp8_recipe_handler = FP8RecipeKwargs()
 
-        if gradient_accumulation_plugin is None:
-            ga_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
-            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=ga_steps)
-        elif gradient_accumulation_steps != 1:
-            raise ValueError("Pass either gradient_accumulation_steps or gradient_accumulation_plugin, not both")
-
+        if deepspeed_plugin is None and os.environ.get("ACCELERATE_DEEPSPEED_CONFIG_FILE"):
+            # launcher --deepspeed_config_file: DeepSpeed-JSON migration shim
+            deepspeed_plugin = ZeroPlugin.from_deepspeed_config(
+                os.environ["ACCELERATE_DEEPSPEED_CONFIG_FILE"]
+            )
         if deepspeed_plugin is None and parse_flag_from_env("ACCELERATE_USE_DEEPSPEED"):
             deepspeed_plugin = ZeroPlugin()
+        if (
+            mixed_precision is None
+            and deepspeed_plugin is not None
+            and getattr(deepspeed_plugin, "inferred_mixed_precision", None)
+        ):
+            # the DS JSON's fp16/bf16 section stands in for --mixed_precision
+            mixed_precision = deepspeed_plugin.inferred_mixed_precision
         if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_USE_FSDP"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
         if megatron_lm_plugin is None and parse_flag_from_env("ACCELERATE_USE_MEGATRON_LM"):
             megatron_lm_plugin = ModelParallelPlugin()
+
+        if gradient_accumulation_plugin is None:
+            if (
+                gradient_accumulation_steps == 1
+                and deepspeed_plugin is not None
+                and deepspeed_plugin.gradient_accumulation_steps
+            ):
+                # DS-JSON migration: the config file's value stands in when the
+                # user passes none (reference fills "auto" the other way round)
+                gradient_accumulation_steps = deepspeed_plugin.gradient_accumulation_steps
+            ga_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=ga_steps)
+        elif gradient_accumulation_steps != 1:
+            raise ValueError("Pass either gradient_accumulation_steps or gradient_accumulation_plugin, not both")
 
         init_kwargs = self.init_handler.to_kwargs() if self.init_handler else {}
         init_kwargs.pop("backend", None)
@@ -1088,6 +1108,10 @@ class Accelerator:
         gradient buffer — semantics of reference ``accumulate()``/``no_sync``
         (``accelerator.py:912-1069``) without the Python-side no_sync dance.
         """
+        if max_grad_norm is None and self.state.zero_plugin is not None:
+            # DS-JSON migration: the config file's gradient_clipping stands in
+            # when the caller passes none
+            max_grad_norm = self.state.zero_plugin.gradient_clipping
         pp_size = mesh_lib.mesh_axis_size(self.mesh, "pp")
         if pp_size > 1 and not getattr(loss_fn, "_pp_aware", False):
             raise ValueError(
@@ -1109,7 +1133,19 @@ class Accelerator:
                 "sp_degree from ModelParallelPlugin."
             )
         wrapped_loss = self._wrap_loss_fn(loss_fn, has_aux)
-        wrapped_loss = self._maybe_remat(wrapped_loss)
+        if getattr(loss_fn, "_pipeline_schedule", None) == "1f1b":
+            # the 1f1b loss computes gradients inside its own forward
+            # (custom_vjp); jax.checkpoint around it would re-run the whole
+            # interleaved schedule — and its O(pp) activation stash already IS
+            # the memory policy
+            if self.compilation_config.remat_policy not in (None, "none"):
+                logger.warning_once(
+                    "remat_policy is ignored for schedule='1f1b' pipeline losses: "
+                    "the interleaved schedule bounds activation memory itself, and "
+                    "checkpointing a custom_vjp would re-run it."
+                )
+        else:
+            wrapped_loss = self._maybe_remat(wrapped_loss)
         accum = self.gradient_accumulation_steps
         policy = self.policy
         fp16 = self._use_loss_scaling

@@ -78,8 +78,13 @@ def launch_command_parser(subparsers=None):
     zero = parser.add_argument_group("ZeRO")
     zero.add_argument("--use_deepspeed", "--use_zero", dest="use_zero", action="store_true")
     zero.add_argument("--zero_stage", type=int, default=None)
-    zero.add_argument("--offload_optimizer_device", default=None, choices=["none", "cpu"])
+    zero.add_argument("--offload_optimizer_device", default=None, choices=["none", "cpu", "nvme"])
     zero.add_argument("--offload_param_device", default=None, choices=["none", "cpu"])
+    zero.add_argument("--offload_optimizer_nvme_path", default=None,
+                      help="Directory for offload_optimizer_device='nvme' (disk tier).")
+    zero.add_argument("--deepspeed_config_file", default=None,
+                      help="DeepSpeed JSON config (migration shim): mapped onto the "
+                           "ZeRO plugin via ZeroPlugin.from_deepspeed_config.")
     # model parallel group (reference MEGATRON_LM_* envs)
     mp = parser.add_argument_group("Model parallelism")
     mp.add_argument("--use_megatron_lm", "--use_model_parallel", dest="use_model_parallel", action="store_true")
@@ -89,6 +94,19 @@ def launch_command_parser(subparsers=None):
                     help="Sequence/context-parallel degree (ring attention over the sp mesh axis).")
     mp.add_argument("--recompute_activations", action="store_true",
                     help="Activation checkpointing for the model-parallel stack (remat).")
+
+    # cloud submission (the reference's sagemaker_launcher boundary, made
+    # TPU-idiomatic: fan the launch out to a GCP TPU pod over SSH)
+    cloud = parser.add_argument_group("Cloud submission")
+    cloud.add_argument("--submit_tpu_pod", default=None, metavar="TPU_NAME",
+                       help="Submit this launch to every worker of the named GCP TPU "
+                            "pod (gcloud compute tpus tpu-vm ssh --worker=all) instead "
+                            "of running locally.")
+    cloud.add_argument("--tpu_zone", default=None, help="GCP zone of --submit_tpu_pod.")
+    cloud.add_argument("--use_alpha", action="store_true",
+                       help="Use `gcloud alpha` for --submit_tpu_pod.")
+    cloud.add_argument("--submit_debug", action="store_true",
+                       help="Print the gcloud command instead of running it.")
 
     parser.add_argument("-m", "--module", action="store_true", help="Treat the script as a python module.")
     parser.add_argument("training_script", help="Script (or module with -m) to launch.")
@@ -133,7 +151,7 @@ def _merge_with_config(args) -> ClusterConfig:
             fc["activation_checkpointing"] = True
         fc.setdefault("sharding_strategy", "FULL_SHARD")
         config.fsdp_config = fc
-    if args.use_zero or args.zero_stage is not None:
+    if args.use_zero or args.zero_stage is not None or args.deepspeed_config_file:
         zc = dict(config.zero_config)
         if args.zero_stage is not None:
             zc["zero_stage"] = args.zero_stage
@@ -141,7 +159,12 @@ def _merge_with_config(args) -> ClusterConfig:
             zc["offload_optimizer_device"] = args.offload_optimizer_device
         if args.offload_param_device is not None:
             zc["offload_param_device"] = args.offload_param_device
-        zc.setdefault("zero_stage", 2)
+        if args.offload_optimizer_nvme_path is not None:
+            zc["nvme_path"] = args.offload_optimizer_nvme_path
+        if args.deepspeed_config_file is not None:
+            zc["deepspeed_config_file"] = args.deepspeed_config_file
+        if "deepspeed_config_file" not in zc:
+            zc.setdefault("zero_stage", 2)
         config.zero_config = zc
     if args.use_model_parallel or args.tp_degree or args.pp_degree or args.sp_degree:
         mc = dict(config.model_parallel_config)
@@ -203,13 +226,20 @@ def prepare_launch_env(
             env["FSDP_ACTIVATION_CHECKPOINTING"] = "true"
     zc = config.zero_config
     if zc:
-        env["ACCELERATE_USE_DEEPSPEED"] = "true"
+        if zc.get("deepspeed_config_file"):
+            # the JSON file is the source of truth; workers rebuild the plugin
+            # via ZeroPlugin.from_deepspeed_config (Accelerator ctor)
+            env["ACCELERATE_DEEPSPEED_CONFIG_FILE"] = str(zc["deepspeed_config_file"])
+        else:
+            env["ACCELERATE_USE_DEEPSPEED"] = "true"
         if zc.get("zero_stage") is not None:
             env["ACCELERATE_DEEPSPEED_ZERO_STAGE"] = str(zc["zero_stage"])
         if zc.get("offload_optimizer_device"):
             env["ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"] = str(zc["offload_optimizer_device"])
         if zc.get("offload_param_device"):
             env["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"] = str(zc["offload_param_device"])
+        if zc.get("nvme_path"):
+            env["ACCELERATE_DEEPSPEED_NVME_PATH"] = str(zc["nvme_path"])
     mc = config.model_parallel_config
     if mc:
         env["ACCELERATE_USE_MEGATRON_LM"] = "true"
@@ -341,10 +371,61 @@ def multi_process_cpu_launcher(args, config: ClusterConfig, num_processes: int) 
     return _supervise(run_gang, args.max_restarts, "gang")
 
 
+def tpu_pod_submit_launcher(args, config) -> int:
+    """Submit this launch to every worker of a GCP TPU pod over SSH.
+
+    The TPU-idiomatic analog of the reference's cloud-submit boundary
+    (``sagemaker_launcher``, reference ``commands/launch.py:886-903``):
+    instead of handing the job to a CUDA-cloud SDK, the command fans out with
+    ``gcloud compute tpus tpu-vm ssh --worker=all`` (``build_tpu_command``,
+    the ``tpu-config`` machinery) and every pod host runs the same
+    ``accelerate-tpu launch``.  The MERGED config (CLI flags + local config
+    file) is serialized to YAML and written to a temp file on each worker,
+    then passed as ``--config_file`` — env exports alone would be clobbered
+    by the remote launcher rebuilding its env from a default local config.
+    Pod topology (process count, coordinator) is auto-discovered by
+    ``jax.distributed`` on the workers.
+    """
+    import shlex
+
+    import yaml
+
+    from .tpu import build_tpu_command
+
+    tpu_name = args.submit_tpu_pod
+    tpu_zone = args.tpu_zone or getattr(config, "tpu_zone", None)
+    if not tpu_zone:
+        raise ValueError(
+            "--submit_tpu_pod needs a zone: pass --tpu_zone or set tpu_zone in "
+            "the config file (`accelerate-tpu config`)."
+        )
+    config_yaml = yaml.safe_dump(config.to_dict(), default_flow_style=False)
+    remote_cfg = "/tmp/accelerate_tpu_submit.yaml"
+    script = " ".join(
+        shlex.quote(a)
+        for a in (["-m", args.training_script] if args.module else [args.training_script])
+        + list(args.training_script_args)
+    )
+    command = (
+        f"printf %s {shlex.quote(config_yaml)} > {remote_cfg} && "
+        f"accelerate-tpu launch --config_file {remote_cfg} {script}"
+    )
+    cmd = build_tpu_command(tpu_name, tpu_zone, [command], use_alpha=args.use_alpha)
+    if args.submit_debug:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    return subprocess.run(cmd).returncode
+
+
 def launch_command(args) -> None:
     from .config.config_args import ComputeEnvironment
 
     config = _merge_with_config(args)
+    if args.submit_tpu_pod:
+        rc = tpu_pod_submit_launcher(args, config)
+        if rc:
+            sys.exit(rc)
+        return
     if config.compute_environment == ComputeEnvironment.AMAZON_SAGEMAKER.value:
         # Reference dispatches to the SageMaker Python SDK (commands/launch.py:886),
         # a CUDA-cloud API with no TPU offering behind it.  Refuse loudly rather
@@ -352,9 +433,11 @@ def launch_command(args) -> None:
         raise ValueError(
             "compute_environment AMAZON_SAGEMAKER is out of scope for the TPU "
             "build: SageMaker provisions CUDA instances via the AWS SDK and has "
-            "no TPU backend. Run on a TPU VM/pod (compute_environment TPU_POD "
-            "with --num_machines/--machine_rank), or use the reference "
-            "framework for SageMaker jobs."
+            "no TPU backend. The cloud-submit equivalent here is "
+            "`accelerate-tpu launch --submit_tpu_pod <name> --tpu_zone <zone>` "
+            "(fans the job out to a GCP TPU pod), or run on the pod directly "
+            "with --num_machines/--machine_rank; use the reference framework "
+            "for SageMaker jobs."
         )
     valid_envs = {e.value for e in ComputeEnvironment}
     if config.compute_environment not in valid_envs:

@@ -2,7 +2,7 @@
 
 from .compression import compressed_pmean, compression_stats, powersgd_init
 from .moe import MoEMLP, router_aux_loss, shard_moe_params, top_k_dispatch
-from .pipeline import pipeline_apply, pipeline_lm_loss_fn, prepare_pipeline, stack_layer_params
+from .pipeline import pipeline_apply, pipeline_lm_loss_fn, prepare_pipeline, schedule_slots, stack_layer_params
 from .ring_attention import (
     ring_attention,
     ring_attention_sharded,

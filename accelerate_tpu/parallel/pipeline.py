@@ -1,4 +1,4 @@
-"""Pipeline parallelism — GPipe microbatch schedule over a ``pp`` mesh axis.
+"""Pipeline parallelism — GPipe and 1F1B microbatch schedules over a ``pp`` mesh axis.
 
 Reference surface: PiPPy inference (``inference.py:78-188`` — trace, split at
 ``split_points``, schedule ``num_chunks`` microbatches) and Megatron's
@@ -6,17 +6,36 @@ Reference surface: PiPPy inference (``inference.py:78-188`` — trace, split at
 pipelines with explicit send/recv; the TPU-native design is a *collective*
 pipeline (scaling-book recipe): every pp rank runs the same compiled program,
 holds one stage's layer stack, and activations rotate one hop per step with
-``lax.ppermute`` while a ``lax.scan`` walks the schedule.  Total steps =
-``num_microbatches + pp - 1`` (the classic GPipe bubble); the ppermute for
-step t+1 is independent of step t's compute, so XLA overlaps transfer with
-the MXU.
+``lax.ppermute`` while a ``lax.scan`` walks the schedule.
 
-Everything is differentiable (``ppermute`` has a transpose rule), so training
-backward — itself a reversed pipeline — falls out of autodiff; no separate
-1F1B machinery is needed at this level.
+Two training schedules (``pipeline_lm_loss_fn(schedule=...)``):
+
+  - ``"gpipe"`` (default): the forward scan runs ``M + pp - 1`` slots
+    (bubble fraction ``(pp-1)/(M+pp-1)``); everything is differentiable
+    (``ppermute`` has a transpose rule) so the backward — itself a reversed
+    pipeline — falls out of autodiff.  Every stage stashes activations for
+    all ``M`` in-flight microbatches between forward and backward: memory
+    O(M) per stage.
+  - ``"1f1b"``: explicit forward/backward interleaving.  One scan of
+    ``M + 2(pp-1)`` slots where, in steady state, every stage performs one
+    forward unit AND one backward unit per slot (the defining
+    one-forward-one-backward cadence); microbatch ``j``'s forward runs at
+    slot ``j + s`` on stage ``s`` and its backward at slot
+    ``j + 2(pp-1) - s``, so a stage holds at most ``2(pp-1-s) + 1``
+    stashed activations — memory O(pp), independent of M.  Backward units
+    recompute their stage forward from the stashed *input* (per-stage
+    rematerialization, as in Megatron's 1F1B-with-recompute) inside
+    ``jax.vjp``; gradients rotate backwards with the opposite ``ppermute``.
+    The whole loss-and-gradients computation runs in the forward pass of a
+    ``jax.custom_vjp`` (autodiff cannot express the interleaving), whose
+    backward merely scales the precomputed gradients by the upstream
+    cotangent.  :func:`schedule_slots` is the single source of the slot
+    counts (asserted by the step-count tests).
 
 Entry points:
   - :func:`pipeline_apply` — generic: stage_fn + stacked per-layer params.
+  - :func:`pipeline_lm_loss_fn` — trainer-integrated LM loss (GPipe or 1F1B,
+    dense or MoE — router aux loss rides the rotation alongside activations).
   - :func:`prepare_pipeline` — the ``prepare_pippy`` analog for the flagship
     Transformer: embed/head replicated, decoder stack pipelined.
 """
@@ -31,6 +50,24 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import mesh_axis_size, present_data_axes
+
+
+def schedule_slots(schedule: str, num_microbatches: int, n_stages: int) -> int:
+    """Scan length of the pipeline schedule — the bubble accounting.
+
+    GPipe: ``M + pp - 1`` slots, one forward unit each (backward is autodiff's
+    mirror image, so a training step costs ~``3*(M+pp-1)`` forward-equivalents
+    with the classic ``(pp-1)/(M+pp-1)`` bubble).  1F1B: ``M + 2(pp-1)``
+    slots, each up to one forward AND one backward unit (~3 forward-equivalents
+    of compute per steady-state slot), bubble ``2(pp-1)/(M+2(pp-1))`` — the
+    memory win (O(pp) vs O(M) stashed activations) buys a slightly longer
+    fill/drain.
+    """
+    if schedule == "gpipe":
+        return num_microbatches + n_stages - 1
+    if schedule == "1f1b":
+        return num_microbatches + 2 * (n_stages - 1)
+    raise ValueError(f"Unknown pipeline schedule {schedule!r}; use 'gpipe' or '1f1b'")
 
 
 def stack_layer_params(params: dict, num_layers: int) -> Any:
@@ -50,6 +87,7 @@ def pipeline_apply(
     *broadcast_args,
     mesh: Mesh,
     axis: str = "pp",
+    carries_aux: bool = False,
 ):
     """Run ``stage_fn`` as a GPipe pipeline over ``mesh[axis]``.
 
@@ -59,6 +97,12 @@ def pipeline_apply(
     (replicated across ``axis``); the output has the same shape.  ``M`` should
     be >= the pp degree to keep the bubble fraction (pp-1)/(M+pp-1) small.
 
+    With ``carries_aux`` the stage_fn signature becomes
+    ``(local_params, x, *bargs) -> (x, aux_scalar)``; each microbatch's aux
+    accumulates across stages by riding the same ``ppermute`` rotation as its
+    activations (the MoE router-aux path), and the return value is
+    ``(outputs, aux [M])``.
+
     When the mesh also has data axes (``dp``/``fsdp``), the per-microbatch
     batch dim (dim 1 of ``microbatches``, dim 0 of every broadcast arg) shards
     over them, so PP composes with data parallelism instead of replicating the
@@ -67,8 +111,8 @@ def pipeline_apply(
     n_stages = mesh_axis_size(mesh, axis)
     num_micro = microbatches.shape[0]
     if n_stages == 1:
-        out = microbatches
-        return jax.vmap(lambda mb: stage_fn(layer_params, mb, *broadcast_args))(out)
+        out = jax.vmap(lambda mb: stage_fn(layer_params, mb, *broadcast_args))(microbatches)
+        return out  # (x[M] or (x[M], aux[M]) — vmap maps the tuple through)
 
     depth = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
     if depth % n_stages:
@@ -78,28 +122,52 @@ def pipeline_apply(
 
     def worker(local_params, mbs, *bargs):
         idx = lax.axis_index(axis)
-        steps = num_micro + n_stages - 1
+        steps = schedule_slots("gpipe", num_micro, n_stages)
         state = jnp.zeros_like(mbs[0])
+        aux_state = jnp.zeros((), jnp.float32)
         out_buf = jnp.zeros_like(mbs)
+        aux_buf = jnp.zeros((num_micro,), jnp.float32)
 
         def body(carry, t):
-            state, out_buf = carry
+            state, aux_state, out_buf, aux_buf = carry
             # stage 0 ingests microbatch t (clamped: trailing steps drain the pipe)
             feed = lax.dynamic_index_in_dim(mbs, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False)
             inp = jnp.where(idx == 0, feed, state)
-            out = stage_fn(local_params, inp, *bargs)
+            aux_in = jnp.where(idx == 0, 0.0, aux_state)
+            if carries_aux:
+                out, aux_stage = stage_fn(local_params, inp, *bargs)
+                aux_out = aux_in + aux_stage.astype(jnp.float32)
+            else:
+                out = stage_fn(local_params, inp, *bargs)
+                aux_out = aux_in
             # last stage finished microbatch t-(n-1) — record it
             w = t - (n_stages - 1)
-            updated = lax.dynamic_update_index_in_dim(out_buf, out, jnp.clip(w, 0, num_micro - 1), 0)
+            wc = jnp.clip(w, 0, num_micro - 1)
+            updated = lax.dynamic_update_index_in_dim(out_buf, out, wc, 0)
             write = jnp.logical_and(idx == n_stages - 1, w >= 0)
             out_buf = jnp.where(write, updated, out_buf)
-            # rotate activations one hop (overlaps with next step's compute)
+            aux_buf = jnp.where(
+                write, lax.dynamic_update_index_in_dim(aux_buf, aux_out, wc, 0), aux_buf
+            )
+            # rotate activations (+ their aux carry) one hop (overlaps compute)
             state = lax.ppermute(out, axis, perm)
-            return (state, out_buf), None
+            aux_state = lax.ppermute(aux_out, axis, perm)
+            return (state, aux_state, out_buf, aux_buf), None
 
-        (state, out_buf), _ = lax.scan(body, (state, out_buf), jnp.arange(steps))
+        (state, aux_state, out_buf, aux_buf), _ = lax.scan(
+            body, (state, aux_state, out_buf, aux_buf), jnp.arange(steps)
+        )
         # replicate the result (only the last stage holds it)
         have = jnp.where(idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
+        if carries_aux:
+            have_aux = jnp.where(idx == n_stages - 1, aux_buf, jnp.zeros_like(aux_buf))
+            have_aux = lax.psum(have_aux, axis)
+            data = present_data_axes(mesh)
+            if data:
+                # router statistics are per-data-shard token means; average
+                # them so the aux output is replicated (out_specs P())
+                have_aux = lax.pmean(have_aux, data)
+            return lax.psum(have, axis), have_aux
         return lax.psum(have, axis)
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
@@ -118,13 +186,77 @@ def pipeline_apply(
     mb_spec = P(None, data) if data else P()
     barg_spec = P(data) if data else P()
     n_bargs = len(broadcast_args)
+    # aux scalars come back replicated: psum over pp + pmean over data axes
+    # happen inside the worker
+    out_specs = (mb_spec, P()) if carries_aux else mb_spec
     return jax.shard_map(
         worker,
         mesh=mesh,
         in_specs=(param_specs, mb_spec) + (barg_spec,) * n_bargs,
-        out_specs=mb_spec,
+        out_specs=out_specs,
         check_vma=False,
     )(layer_params, microbatches, *broadcast_args)
+
+
+def _resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
+    # LAZY: resolved at trace/call time, not construction time — a loss
+    # built before its Accelerator must bind the pp mesh that is active
+    # when the step compiles, not whatever mesh (or none) existed earlier.
+    if mesh is not None:
+        return mesh
+    from ..state import PartialState
+
+    return PartialState().mesh
+
+
+def _resolve_num_microbatches(num_microbatches: Optional[int]) -> int:
+    if num_microbatches is not None:
+        return num_microbatches
+    # default from the active ModelParallelPlugin (reference MegatronLMPlugin
+    # num_micro_batches / pippy num_chunks), else the classic GPipe 8
+    from ..state import AcceleratorState
+
+    plugin = (
+        AcceleratorState().model_parallel_plugin
+        if AcceleratorState._shared_state
+        else None
+    )
+    return plugin.num_micro_batches if plugin is not None else 8
+
+
+def _make_stage_fn(cfg, with_aux: bool):
+    """Stage body: scan one stage's layer slice over the hidden states.
+
+    ``with_aux`` (MoE): each layer's sown ``router_aux_loss`` is collected
+    from mutable intermediates and summed — signature becomes
+    ``(local_layers, x, positions) -> (x, aux_scalar)``.
+    """
+    from ..models.transformer import DecoderLayer
+
+    if not with_aux:
+        def stage_fn(local_layers, x, positions):
+            def body(h, layer_params):
+                return DecoderLayer(cfg).apply({"params": layer_params}, h, positions), None
+
+            x, _ = lax.scan(body, x, local_layers)
+            return x
+
+        return stage_fn
+
+    from .moe import router_aux_loss
+
+    def stage_fn(local_layers, x, positions):
+        def body(carry, layer_params):
+            h, aux = carry
+            out, mut = DecoderLayer(cfg).apply(
+                {"params": layer_params}, h, positions, mutable=["intermediates"]
+            )
+            return (out, aux + router_aux_loss(mut["intermediates"], 1.0)), None
+
+        (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), local_layers)
+        return x, aux
+
+    return stage_fn
 
 
 def pipeline_lm_loss_fn(
@@ -132,39 +264,339 @@ def pipeline_lm_loss_fn(
     mesh: Optional[Mesh] = None,
     num_microbatches: Optional[int] = None,
     axis: str = "pp",
+    schedule: str = "gpipe",
 ):
     """Next-token LM loss with the decoder stack pipelined over ``mesh[axis]``
     — the trainer-integrated PP path (the reference trains PP only through
     Megatron's ``pp_degree``, ``utils/dataclasses.py:1318``).
 
     Drop-in for :func:`~accelerate_tpu.models.transformer.lm_loss_fn` inside
-    ``Accelerator.compile_train_step``: the whole GPipe schedule (microbatch
-    scan + ``ppermute`` rotation) sits inside the loss, so fwd+bwd autodiff
-    gives the reversed backward pipeline and gradient accumulation/clipping/
-    optimizer update compose unchanged.  The function is marked ``_pp_aware``;
-    ``compile_train_step`` REJECTS non-aware losses on a pp>1 mesh rather than
-    silently replicating compute across the pp devices.
+    ``Accelerator.compile_train_step``: the whole schedule (microbatch scan +
+    ``ppermute`` rotation) sits inside the loss, so gradient accumulation,
+    clipping and the optimizer update compose unchanged.  ``schedule`` picks
+    GPipe (autodiff backward, O(M)-activations) or 1F1B (explicit
+    interleaving, O(pp)-activations) — see the module docstring and
+    :func:`schedule_slots` for the bubble accounting.  MoE configs are
+    supported on both schedules: each microbatch's router aux loss rides the
+    rotation with its activations and is added as
+    ``router_aux_loss_coef * mean_over_microbatches(aux)`` (per-microbatch
+    router statistics — DeepSpeed/Megatron MoE semantics; the monolithic
+    ``lm_loss_fn`` computes the same statistic over the whole batch at once).
+    The function is marked ``_pp_aware``; ``compile_train_step`` REJECTS
+    non-aware losses on a pp>1 mesh rather than silently replicating compute
+    across the pp devices.
     """
-    from ..models.transformer import cross_entropy_loss
+    from ..models.transformer import cross_entropy_loss, shift_labels
 
     cfg = model.config
-    if getattr(cfg, "num_experts", 0) > 0:
-        raise NotImplementedError(
-            "pipeline_lm_loss_fn does not support MoE configs: the router aux "
-            "loss is sown outside the pipelined stack. Use ep-sharding for MoE "
-            "models (ModelParallelPlugin(expert_parallel_degree=...))."
-        )
+    schedule_slots(schedule, 8, 1)  # validate the schedule name eagerly
+    is_moe = getattr(cfg, "num_experts", 0) > 0 and cfg.router_aux_loss_coef > 0.0
+
+    if schedule == "1f1b":
+        return _pipeline_1f1b_lm_loss(model, mesh, num_microbatches, axis)
+
     forward = prepare_pipeline(
-        model, None, mesh=mesh, num_microbatches=num_microbatches, axis=axis, jit=False
+        model, None, mesh=mesh, num_microbatches=num_microbatches, axis=axis,
+        jit=False, with_aux=is_moe,
     )
 
     def loss_fn(params, batch, rng=None):
-        from ..models.transformer import shift_labels
-
+        if is_moe:
+            logits, aux = forward(params, batch["input_ids"])
+            return cross_entropy_loss(logits, shift_labels(batch)) + (
+                cfg.router_aux_loss_coef * jnp.mean(aux)
+            )
         logits = forward(params, batch["input_ids"])
         return cross_entropy_loss(logits, shift_labels(batch))
 
     loss_fn._pp_aware = True
+    return loss_fn
+
+
+def _split_params_for_pipeline(cfg, p):
+    """(stack, embed, head, rebuild): decompose the transformer param tree into
+    the pipelined stack, the embedding, and the head (final_norm + lm_head or
+    the tied embedding), plus a function mapping (g_stack, g_embed, g_head)
+    back onto the original tree structure (summing the tied-embedding
+    contributions)."""
+    stack = stack_layer_params(p, cfg.num_layers)
+    head = {"final_norm": p["final_norm"]}
+    if not cfg.tie_word_embeddings:
+        head["head"] = p["lm_head"]
+    scanned = "layers" in p
+
+    def rebuild(g_stack, g_embed, g_head):
+        g = {"final_norm": g_head["final_norm"]}
+        if cfg.tie_word_embeddings:
+            # embed grads = embedding-lookup path + attend (head) path
+            g["embed_tokens"] = jax.tree_util.tree_map(
+                lambda a, b: a + b, g_embed, g_head["embed"]
+            )
+        else:
+            g["embed_tokens"] = g_embed
+            g["lm_head"] = g_head["head"]
+        if scanned:
+            g["layers"] = {"layer": g_stack}
+        else:
+            for i in range(cfg.num_layers):
+                g[f"layers_{i}"] = jax.tree_util.tree_map(lambda x: x[i], g_stack)
+        return g
+
+    return stack, p["embed_tokens"], head, rebuild
+
+
+def _pipeline_1f1b_lm_loss(model, mesh, num_microbatches, axis):
+    """1F1B LM loss: loss AND parameter gradients computed by one interleaved
+    forward/backward schedule inside the forward pass of a ``jax.custom_vjp``
+    (see the module docstring for the slot math).
+
+    Per scan slot each stage performs one forward unit (stage recompute stash
+    write, activation ``ppermute`` forward) and one backward unit (stage
+    recompute + ``jax.vjp`` from the stashed input, gradient ``ppermute``
+    backward); the last stage seeds each microbatch's backward from the head
+    loss VJP in the same slot its forward completes.  Cross-entropy is
+    normalized by the GLOBAL non-ignored-token count (computed from the
+    labels before the schedule, so per-microbatch head cotangents are exact),
+    and the MoE router aux cotangent is the constant ``coef / M``.
+    """
+    import flax.linen as nn
+
+    from ..models.transformer import RMSNorm, shift_labels
+
+    cfg = model.config
+    is_moe = getattr(cfg, "num_experts", 0) > 0 and cfg.router_aux_loss_coef > 0.0
+    stage_fn = _make_stage_fn(cfg, is_moe)
+    f32 = jnp.float32
+
+    def embed_fn(p_embed, tokens):
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+        )
+        return embed.apply({"params": p_embed}, tokens)
+
+    def head_nll(p_head, x, labels):
+        """Unreduced token NLL sum for one microbatch (fp32)."""
+        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply(
+            {"params": p_head["final_norm"]}, x
+        )
+        if cfg.tie_word_embeddings:
+            embed = nn.Embed(
+                cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+            )
+            logits = embed.apply(
+                {"params": p_head["embed"]}, x.astype(cfg.param_dtype), method="attend"
+            )
+        else:
+            logits = x @ p_head["head"]["kernel"].astype(cfg.dtype)
+        logits = logits.astype(jnp.float32)
+        mask = labels != -100
+        safe = jnp.where(mask, labels, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(mask, logz - label_logits, 0.0))
+
+    def loss_and_grads(params, input_ids, labels):
+        mesh_r = _resolve_mesh(mesh)
+        M = _resolve_num_microbatches(num_microbatches)
+        pp = mesh_axis_size(mesh_r, axis)
+        b, s = input_ids.shape
+        if b % M:
+            raise ValueError(f"Batch {b} not divisible by {M} microbatches")
+        stack, p_embed, head, rebuild = _split_params_for_pipeline(cfg, params)
+        if cfg.tie_word_embeddings:
+            head = dict(head, embed=p_embed)
+        depth = jax.tree_util.tree_leaves(stack)[0].shape[0]
+        if pp > 1 and depth % pp:
+            raise ValueError(f"{depth} layers do not split into {pp} pipeline stages")
+
+        tokens_mbs = input_ids.reshape(M, b // M, s)
+        labels_mbs = labels.reshape(M, b // M, s)
+        data = present_data_axes(mesh_r)
+        if data:
+            n_data = 1
+            for a in data:
+                n_data *= mesh_r.shape[a]
+            if (b // M) % n_data:
+                raise ValueError(
+                    f"Per-microbatch batch {b // M} does not divide the data axes "
+                    f"(= {n_data} shards); use fewer microbatches or a larger batch."
+                )
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        stash_size = 2 * (pp - 1) + 1
+        T = schedule_slots("1f1b", M, pp)
+        aux_cot = f32(cfg.router_aux_loss_coef / M) if is_moe else None
+
+        def worker(stack_local, p_embed_w, head_w, tokens, labels_w):
+            idx = lax.axis_index(axis)
+            is_first = idx == 0
+            is_last = idx == pp - 1
+            mb_local = tokens.shape[1]
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (mb_local, s))
+            act_shape = (mb_local, s, cfg.hidden_size)
+
+            # global non-ignored token count: normalizes every head cotangent
+            cnt = jnp.sum(labels_w != -100).astype(f32)
+            if data:
+                cnt = lax.psum(cnt, data)
+            inv_cnt = 1.0 / jnp.maximum(cnt, 1.0)
+
+            def run_stage(sp, x):
+                out = stage_fn(sp, x, positions)
+                return out if is_moe else (out, jnp.float32(0.0))
+
+            def head_vjp(x, labels_f):
+                nll, hvjp = jax.vjp(lambda xx, ph: head_nll(ph, xx, labels_f), x, head_w)
+                dx, dph = hvjp(inv_cnt)
+                return nll, dx.astype(cfg.dtype), dph
+
+            zeros_f32 = lambda t: jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, f32), t
+            )
+            carry0 = (
+                jnp.zeros(act_shape, cfg.dtype),          # act_recv
+                jnp.zeros((), f32),                        # aux_recv
+                jnp.zeros(act_shape, cfg.dtype),          # grad_recv
+                jnp.zeros((stash_size,) + act_shape, cfg.dtype),  # input stash
+                zeros_f32(stack_local),                    # grad accum: stack
+                zeros_f32(p_embed_w),                      # grad accum: embed
+                zeros_f32(head_w),                         # grad accum: head
+                jnp.zeros((), f32),                        # nll sum (normalized)
+                jnp.zeros((), f32),                        # aux sum over mbs
+            )
+
+            def body(carry, t):
+                (act_recv, aux_recv, grad_recv, stash,
+                 g_stack, g_embed, g_head, nll_sum, aux_sum) = carry
+                f = t - idx
+                bwd = t - 2 * (pp - 1) + idx
+                do_f = jnp.logical_and(f >= 0, f < M)
+                do_b = jnp.logical_and(bwd >= 0, bwd < M)
+                fc = jnp.clip(f, 0, M - 1)
+                bc = jnp.clip(bwd, 0, M - 1)
+
+                # ---------------- forward unit
+                tokens_f = lax.dynamic_index_in_dim(tokens, fc, 0, keepdims=False)
+                x_in = jnp.where(
+                    is_first, embed_fn(p_embed_w, tokens_f).astype(cfg.dtype), act_recv
+                )
+                out, aux_stage = run_stage(stack_local, x_in)
+                aux_out = jnp.where(is_first, 0.0, aux_recv) + aux_stage
+
+                labels_f = lax.dynamic_index_in_dim(labels_w, fc, 0, keepdims=False)
+                nll_f, dx_head, dph = head_vjp(out, labels_f)
+                take_f = jnp.logical_and(is_last, do_f)
+                nll_sum = nll_sum + jnp.where(take_f, nll_f * inv_cnt, 0.0)
+                aux_sum = aux_sum + jnp.where(take_f, aux_out, 0.0)
+                g_head = jax.tree_util.tree_map(
+                    lambda acc, d: acc + jnp.where(take_f, d.astype(f32), 0.0), g_head, dph
+                )
+
+                stash = jnp.where(
+                    do_f,
+                    lax.dynamic_update_index_in_dim(stash, x_in, t % stash_size, 0),
+                    stash,
+                )
+
+                # ---------------- backward unit (stage recompute + VJP)
+                x_b = lax.dynamic_index_in_dim(
+                    stash, (bc + idx) % stash_size, 0, keepdims=False
+                )
+                g_in = jnp.where(is_last, dx_head, grad_recv)
+                _, svjp = jax.vjp(lambda sp, xx: run_stage(sp, xx), stack_local, x_b)
+                dstack, dx = svjp((g_in.astype(cfg.dtype), aux_cot if is_moe else f32(0.0)))
+                g_stack = jax.tree_util.tree_map(
+                    lambda acc, d: acc + jnp.where(do_b, d.astype(f32), 0.0), g_stack, dstack
+                )
+                tokens_b = lax.dynamic_index_in_dim(tokens, bc, 0, keepdims=False)
+                _, evjp = jax.vjp(lambda pe: embed_fn(pe, tokens_b).astype(cfg.dtype), p_embed_w)
+                (dpe,) = evjp(dx)
+                take_b0 = jnp.logical_and(is_first, do_b)
+                g_embed = jax.tree_util.tree_map(
+                    lambda acc, d: acc + jnp.where(take_b0, d.astype(f32), 0.0), g_embed, dpe
+                )
+
+                # ---------------- rotations (overlap with next slot's compute)
+                act_recv = lax.ppermute(out, axis, perm_fwd)
+                aux_recv = lax.ppermute(aux_out, axis, perm_fwd)
+                grad_recv = lax.ppermute(dx.astype(cfg.dtype), axis, perm_bwd)
+                return (act_recv, aux_recv, grad_recv, stash,
+                        g_stack, g_embed, g_head, nll_sum, aux_sum), None
+
+            carry, _ = lax.scan(body, carry0, jnp.arange(T))
+            (_, _, _, _, g_stack, g_embed, g_head, nll_sum, aux_sum) = carry
+
+            # loss lives on the last stage only; replicated grads need the
+            # cross-stage sum (each stage contributes zeros elsewhere)
+            nll_sum = lax.psum(nll_sum, axis)
+            aux_sum = lax.psum(aux_sum, axis)
+            g_embed = lax.psum(g_embed, axis)
+            g_head = lax.psum(g_head, axis)
+            if data:
+                # data-parallel gradient reduction (the transpose of the
+                # replicated-param in_specs autodiff would otherwise insert);
+                # nll/cnt are already globally normalized sums
+                nll_sum = lax.psum(nll_sum, data)
+                aux_sum = lax.pmean(aux_sum, data)
+                g_stack = lax.psum(g_stack, data)
+                g_embed = lax.psum(g_embed, data)
+                g_head = lax.psum(g_head, data)
+            loss = nll_sum
+            if is_moe:
+                loss = loss + cfg.router_aux_loss_coef * aux_sum / M
+            return loss, g_stack, g_embed, g_head
+
+        if pp == 1:
+            raise ValueError(
+                "schedule='1f1b' needs a pp axis of size > 1; on a single stage "
+                "use schedule='gpipe' (identical computation, no pipeline)."
+            )
+        stack_specs = jax.tree_util.tree_map(lambda _: P(axis), stack)
+        rep = P()
+        mb_spec = P(None, data) if data else P()
+        loss, g_stack, g_embed, g_head = jax.shard_map(
+            worker,
+            mesh=mesh_r,
+            in_specs=(stack_specs, rep, rep, mb_spec, mb_spec),
+            out_specs=(P(), stack_specs, rep, rep),
+            check_vma=False,
+        )(stack, p_embed, head, tokens_mbs, labels_mbs)
+
+        grads = rebuild(
+            jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), g_stack, stack),
+            jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), g_embed, p_embed),
+            jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), g_head, head),
+        )
+        return loss, grads
+
+    @jax.custom_vjp
+    def loss_1f1b(params, input_ids, labels):
+        return loss_and_grads(params, input_ids, labels)[0]
+
+    def fwd(params, input_ids, labels):
+        loss, grads = loss_and_grads(params, input_ids, labels)
+        return loss, (grads, input_ids.shape, labels.shape)
+
+    def bwd(res, g):
+        import numpy as np
+
+        grads, ids_shape, labels_shape = res
+        d_params = jax.tree_util.tree_map(lambda x: (x.astype(f32) * g).astype(x.dtype), grads)
+        # integer primals take symbolic-zero (float0) cotangents
+        return (
+            d_params,
+            np.zeros(ids_shape, jax.dtypes.float0),
+            np.zeros(labels_shape, jax.dtypes.float0),
+        )
+
+    loss_1f1b.defvjp(fwd, bwd)
+
+    def loss_fn(params, batch, rng=None):
+        labels = shift_labels(batch)
+        return loss_1f1b(params, batch["input_ids"], labels)
+
+    loss_fn._pp_aware = True
+    loss_fn._pipeline_schedule = "1f1b"
     return loss_fn
 
 
@@ -175,62 +607,41 @@ def prepare_pipeline(
     num_microbatches: Optional[int] = None,
     axis: str = "pp",
     jit: bool = True,
+    with_aux: bool = False,
 ):
     """Pipeline-parallel forward for the flagship Transformer (reference
     ``prepare_pippy``, ``inference.py:126-188``).
 
     Embedding, final norm and LM head run replicated on every pp rank (they
     are small next to the decoder stack); the stacked decoder layers are split
-    into ``mesh[axis]`` stages.  Returns ``fn(params, input_ids) -> logits``.
+    into ``mesh[axis]`` stages.  Returns ``fn(params, input_ids) -> logits``
+    (``(logits, per_microbatch_aux)`` with ``with_aux`` — the MoE router
+    path).
     """
-    from ..models.transformer import DecoderLayer, RMSNorm
+    from ..models.transformer import RMSNorm
     import flax.linen as nn
 
     cfg = model.config
-
-    def resolve_mesh() -> Mesh:
-        # LAZY: resolved at trace/call time, not construction time — a loss
-        # built before its Accelerator must bind the pp mesh that is active
-        # when the step compiles, not whatever mesh (or none) existed earlier.
-        if mesh is not None:
-            return mesh
-        from ..state import PartialState
-
-        return PartialState().mesh
-
-    def resolve_num_microbatches() -> int:
-        if num_microbatches is not None:
-            return num_microbatches
-        # default from the active ModelParallelPlugin (reference MegatronLMPlugin
-        # num_micro_batches / pippy num_chunks), else the classic GPipe 8
-        from ..state import AcceleratorState
-
-        plugin = (
-            AcceleratorState().model_parallel_plugin
-            if AcceleratorState._shared_state
-            else None
-        )
-        return plugin.num_micro_batches if plugin is not None else 8
-
-    def stage_fn(local_layers, x, positions):
-        def body(h, layer_params):
-            return DecoderLayer(cfg).apply({"params": layer_params}, h, positions), None
-
-        x, _ = lax.scan(body, x, local_layers)
-        return x
+    stage_fn = _make_stage_fn(cfg, with_aux)
 
     def forward(p, input_ids):
-        mesh = resolve_mesh()
-        num_microbatches = resolve_num_microbatches()
+        mesh_r = _resolve_mesh(mesh)
+        M = _resolve_num_microbatches(num_microbatches)
         b, s = input_ids.shape
-        if b % num_microbatches:
-            raise ValueError(f"Batch {b} not divisible by {num_microbatches} microbatches")
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // num_microbatches, s))
+        if b % M:
+            raise ValueError(f"Batch {b} not divisible by {M} microbatches")
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // M, s))
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         x = embed.apply({"params": p["embed_tokens"]}, input_ids)
-        mbs = x.reshape(num_microbatches, b // num_microbatches, s, cfg.hidden_size)
+        mbs = x.reshape(M, b // M, s, cfg.hidden_size)
         layer_params = stack_layer_params(p, cfg.num_layers)
-        out = pipeline_apply(stage_fn, layer_params, mbs, positions, mesh=mesh, axis=axis)
+        out = pipeline_apply(
+            stage_fn, layer_params, mbs, positions, mesh=mesh_r, axis=axis,
+            carries_aux=with_aux,
+        )
+        aux = None
+        if with_aux:
+            out, aux = out
         x = out.reshape(b, s, cfg.hidden_size)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": p["final_norm"]}, x)
         if cfg.tie_word_embeddings:
@@ -241,6 +652,7 @@ def prepare_pipeline(
             )
         else:
             logits = x @ p["lm_head"]["kernel"].astype(cfg.dtype)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return (logits, aux) if with_aux else logits
 
     return jax.jit(forward) if jit else forward

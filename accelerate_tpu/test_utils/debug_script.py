@@ -1,0 +1,36 @@
+"""Bundled debug-mode failure script: a deliberately mismatched collective.
+
+Run under ``accelerate-tpu launch`` with ``ACCELERATE_DEBUG_MODE=1``: every
+rank calls ``gather`` with a DIFFERENT tensor shape.  Operation verification
+(``utils/operations.py`` ``verify_operation``, reference
+``operations.py:361-421``) must gather the shape metadata first and raise
+:class:`DistributedOperationException` on every rank — loudly, BEFORE the
+real collective can deadlock or crash the runtime.  The launcher test asserts
+the process exits with the exception text within the timeout.
+"""
+
+from __future__ import annotations
+
+
+def main():
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils.operations import DistributedOperationException
+
+    accelerator = Accelerator()
+    if accelerator.num_processes < 2:
+        raise SystemExit("needs >= 2 processes to mismatch shapes")
+    # rank r contributes a [4 + r] tensor — shapes disagree across ranks
+    x = jnp.ones((4 + accelerator.process_index,), jnp.float32)
+    try:
+        accelerator.gather(x)
+    except DistributedOperationException as e:
+        print(f"[{accelerator.process_index}] caught mismatch before the "
+              f"collective ran: {type(e).__name__}")
+        raise
+    raise AssertionError("mismatched gather did not raise under debug mode")
+
+
+if __name__ == "__main__":
+    main()

@@ -190,6 +190,52 @@ def distributed_vs_single_check(accelerator):
     )
 
 
+def grad_sync_check(accelerator):
+    """Accumulation-boundary semantics across real processes (reference
+    ``tests/test_sync.py``: grads equal/differ across ``no_sync``/
+    ``accumulate`` boundaries).
+
+    Compiled-step form of the same contract: on micro (non-sync) steps the
+    params must NOT move and ``sync_gradients`` is False; on the sync step
+    the update applies and every process ends with bit-identical params
+    (the cross-replica gradient reduction really happened).
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, GradientState
+
+    # a second Accelerator with accumulation shares the singleton state
+    acc = Accelerator(gradient_accumulation_steps=2)
+    state = acc.create_train_state(params={"w": jnp.zeros((4, 1))}, tx=optax.sgd(0.1))
+    step = acc.compile_train_step(
+        lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+    )
+    # per-process DIFFERENT data: the sync step must still agree everywhere
+    rng = np.random.default_rng(acc.process_index)
+    batch = {
+        "x": jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32)),
+        "y": jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32)),
+    }
+    p0 = host_value(state.params["w"]).copy()
+    state, _ = step(state, batch)          # micro step
+    assert not acc.gradient_state.sync_gradients
+    np.testing.assert_array_equal(host_value(state.params["w"]), p0)
+    state, _ = step(state, batch)          # sync step
+    assert acc.gradient_state.sync_gradients
+    w = host_value(state.params["w"])
+    assert not np.array_equal(w, p0), "sync step did not update params"
+    gathered = np.asarray(acc.gather(jnp.asarray(w)[None]))
+    for r in range(gathered.shape[0]):
+        np.testing.assert_array_equal(
+            gathered[r], gathered[0],
+            err_msg="params diverged across processes after the sync step",
+        )
+    # restore the default singleton for any later checks
+    GradientState._reset_state()
+    print(f"[{acc.process_index}] grad sync across accumulate boundary: OK")
+
+
 def main():
     from accelerate_tpu import Accelerator
 
@@ -201,6 +247,7 @@ def main():
     dispatcher_check(accelerator)
     training_check(accelerator)
     distributed_vs_single_check(accelerator)
+    grad_sync_check(accelerator)
     accelerator.print("All self-tests passed.")
 
 

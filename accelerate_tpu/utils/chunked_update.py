@@ -48,11 +48,14 @@ import optax
 # plus the transient update — budget 12 bytes/element when sizing groups.
 _BYTES_PER_ELEMENT = 12
 
-# Measured per-chunk HBM transient relative to the chunk's 12 B/element state:
-# in + out stream copies plus the adam temps run ~4x the chunk footprint
-# (BENCH_NOTES.md: 1 GB chunks reliable next to an 8.5 GB resident set on a
-# 16 GB chip; 2 GB chunks OOM intermittently).
-_CHUNK_TRANSIENT_FACTOR = 4
+# Measured per-chunk HBM budget relative to the chunk's 12 B/element state:
+# in + out stream copies plus the adam temps run ~4x the chunk footprint, and
+# the allocator needs slack on top to avoid thrashing near the limit.  Swept
+# on the 2.13B zero3 config on a 16 GB v5e (BENCH_NOTES.md round 4): with an
+# ~8.5 GB resident set, 1 GB chunks run 17.2 s/step, 1.47 GB chunks (a
+# factor-4 budget) collapse to 42 s/step, 2 GB OOM intermittently.  Factor 6
+# lands the adaptive size at the measured optimum.
+_CHUNK_TRANSIENT_FACTOR = 6
 
 # Conservative per-chip HBM capacities (bytes) by device_kind prefix, for
 # runtimes without memory_stats() (axon tunnels return None).  Public specs.

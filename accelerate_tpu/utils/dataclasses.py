@@ -343,8 +343,12 @@ class FullyShardedDataParallelPlugin:
     # In-flight window for the chunked update: how many chunk programs may be
     # dispatched before blocking on the oldest.  2 (double-buffer) overlaps
     # chunk N's host write-back with chunk N+1's host read at peak HBM =
-    # overlap * chunk transients; 1 restores the fully serialized update.
-    offload_update_overlap: int = 2
+    # overlap * chunk transients.  Default 1 (fully serialized): measured on
+    # a 16 GB v5e, the doubled transient footprint made the allocator thrash
+    # and the overlapped run came out 2x SLOWER than serialized
+    # (BENCH_NOTES.md round-4 zero3 rows) — raise it only with HBM headroom
+    # to spare.  Numerics are barrier-placement-invariant either way.
+    offload_update_overlap: int = 1
     # Disk ("nvme") tier for the offloaded optimizer state: when set (and
     # offload_optimizer is on), the chunked update's source is mmap'd .dat
     # files under this path instead of pinned host memory
@@ -442,7 +446,8 @@ class ZeroPlugin:
     # chunks = fewer compiled chunk programs (compile time) at more HBM per
     # stream.
     offload_update_chunk_mb: Optional[int] = None
-    # In-flight chunk window (None = FSDP plugin default, 2 = double-buffer).
+    # In-flight chunk window (None = FSDP plugin default, 1 = serialized;
+    # 2 = double-buffer — see the FSDP plugin field note).
     offload_update_overlap: Optional[int] = None
     # Note: the reference's zero3_init_flag (meta-device init) has no knob here
     # because create_train_state always initializes abstractly (jax.eval_shape +
@@ -451,12 +456,19 @@ class ZeroPlugin:
     # big_modeling/utils.offload.
 
     def __post_init__(self):
+        # set by from_deepspeed_config when the JSON enables fp16/bf16;
+        # consumed by Accelerator when no explicit mixed_precision is given
+        self.inferred_mixed_precision: Optional[str] = getattr(
+            self, "inferred_mixed_precision", None
+        )
         if os.environ.get("ACCELERATE_DEEPSPEED_ZERO_STAGE"):
             self.zero_stage = int(os.environ["ACCELERATE_DEEPSPEED_ZERO_STAGE"])
         if os.environ.get("ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"):
             self.offload_optimizer_device = os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"]
         if os.environ.get("ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"):
             self.offload_param_device = os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"]
+        if os.environ.get("ACCELERATE_DEEPSPEED_NVME_PATH"):
+            self.nvme_path = os.environ["ACCELERATE_DEEPSPEED_NVME_PATH"]
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"ZeRO stage must be 0-3, got {self.zero_stage}")
         if self.offload_optimizer_device not in ("none", "cpu", "nvme"):
@@ -477,6 +489,120 @@ class ZeroPlugin:
                 "Disk-backed weight streaming is available via "
                 "big_modeling.load_checkpoint_and_dispatch."
             )
+
+    @classmethod
+    def from_deepspeed_config(cls, path: str, **overrides) -> "ZeroPlugin":
+        """Build a :class:`ZeroPlugin` from a DeepSpeed JSON config file — the
+        migration shim for the reference's ``hf_ds_config``/
+        ``--deepspeed_config_file`` flow (``accelerator.py:1617-1745``,
+        ``examples/deepspeed_config_templates/``).
+
+        Mapped keys:
+
+        - ``zero_optimization.stage`` → ``zero_stage``
+        - ``zero_optimization.offload_optimizer.device`` / ``.nvme_path`` →
+          ``offload_optimizer_device`` / ``nvme_path``
+        - ``zero_optimization.offload_param.device`` → ``offload_param_device``
+          (``nvme`` falls back to ``cpu`` with a warning — param streaming on
+          this stack is big_modeling's disk loader, not a training-state tier)
+        - ``zero_optimization.sub_group_size`` → ``offload_update_chunk_mb``
+          (DeepSpeed's optimizer-update granularity in *elements*; converted
+          at 12 B/element, the chunked update's budget unit)
+        - ``zero_optimization.stage3_gather_16bit_weights_on_model_save`` →
+          ``zero3_save_16bit_model``
+        - ``gradient_accumulation_steps``, ``gradient_clipping``,
+          ``train_micro_batch_size_per_gpu`` → same-named fields
+        - ``fp16.enabled`` / ``bf16.enabled`` → :attr:`inferred_mixed_precision`
+          (consumed by ``Accelerator`` when the user passes none)
+
+        ``"auto"`` values resolve to the field defaults (the reference fills
+        them at ``prepare()`` time from the accelerator; here the Accelerator
+        ctor and create_train_state are that moment).  Unmappable sections
+        (optimizer/scheduler — bring an optax transform; comm/bucket tuning —
+        XLA schedules collectives; logging knobs) produce one summary warning.
+        """
+        import json as _json
+        import warnings
+
+        with open(path) as f:
+            ds = _json.load(f)
+
+        def resolved(value, default=None):
+            return default if value in ("auto", None) else value
+
+        kwargs: Dict[str, Any] = {}
+        zero = ds.get("zero_optimization", {})
+        if resolved(zero.get("stage")) is not None:
+            kwargs["zero_stage"] = int(zero["stage"])
+        off_opt = zero.get("offload_optimizer", {}) or {}
+        device = resolved(off_opt.get("device"), "none") or "none"
+        if device != "none":
+            kwargs["offload_optimizer_device"] = device
+            if device == "nvme":
+                kwargs["nvme_path"] = resolved(off_opt.get("nvme_path"))
+        off_param = zero.get("offload_param", {}) or {}
+        p_device = resolved(off_param.get("device"), "none") or "none"
+        if p_device == "nvme":
+            warnings.warn(
+                "offload_param.device='nvme' has no training-state tier here; "
+                "using 'cpu' (pinned host). Disk-streamed weights are served by "
+                "big_modeling.load_checkpoint_and_dispatch.",
+                stacklevel=2,
+            )
+            p_device = "cpu"
+        if p_device != "none":
+            kwargs["offload_param_device"] = p_device
+        sub_group = resolved(zero.get("sub_group_size"))
+        if sub_group is not None and device in ("cpu", "nvme"):
+            # elements -> MB of streamed state at 12 B/element
+            kwargs["offload_update_chunk_mb"] = max(1, int(float(sub_group)) * 12 >> 20)
+        save16 = resolved(zero.get("stage3_gather_16bit_weights_on_model_save"))
+        if save16 is not None:
+            kwargs["zero3_save_16bit_model"] = bool(save16)
+        if resolved(ds.get("gradient_accumulation_steps")) is not None:
+            kwargs["gradient_accumulation_steps"] = int(ds["gradient_accumulation_steps"])
+        if resolved(ds.get("gradient_clipping")) is not None:
+            kwargs["gradient_clipping"] = float(ds["gradient_clipping"])
+        if resolved(ds.get("train_micro_batch_size_per_gpu")) is not None:
+            kwargs["train_micro_batch_size_per_gpu"] = int(ds["train_micro_batch_size_per_gpu"])
+
+        mixed = None
+        if resolved(ds.get("bf16", {}).get("enabled"), False):
+            mixed = "bf16"
+        elif resolved(ds.get("fp16", {}).get("enabled"), False):
+            mixed = "fp16"
+
+        known = {
+            "zero_optimization", "gradient_accumulation_steps", "gradient_clipping",
+            "train_micro_batch_size_per_gpu", "fp16", "bf16",
+        }
+        known_zero = {"stage", "offload_optimizer", "offload_param",
+                      "sub_group_size", "stage3_gather_16bit_weights_on_model_save"}
+        unmapped = sorted(set(ds) - known)
+        # sub-keys matter too: bucket/comm tuning lives INSIDE zero_optimization
+        # (XLA schedules collectives; there is no knob to honor here)
+        unmapped += [f"zero_optimization.{k}" for k in sorted(set(zero) - known_zero)]
+        unmapped += [
+            f"zero_optimization.offload_optimizer.{k}"
+            for k in sorted(set(off_opt) - {"device", "nvme_path"})
+        ]
+        unmapped += [
+            f"zero_optimization.offload_param.{k}"
+            for k in sorted(set(off_param) - {"device", "nvme_path"})
+        ]
+        if unmapped:
+            warnings.warn(
+                f"DeepSpeed config keys without a TPU-runtime mapping (ignored): "
+                f"{unmapped}. Optimizer/scheduler sections: pass an optax "
+                "transform to create_train_state (and AcceleratedScheduler); "
+                "comm/bucket tuning is handled by XLA.",
+                stacklevel=2,
+            )
+
+        kwargs.update(overrides)
+        plugin = cls(**kwargs)
+        plugin.inferred_mixed_precision = mixed
+        return plugin
 
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
         """Lower the ZeRO description onto the single sharding mechanism.

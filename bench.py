@@ -245,7 +245,7 @@ def _bench_train_config(
     )
 
 
-def bench_zero3(smoke: bool = False, batch: int = 4, chunk_mb: int = -1, overlap: int = 2,
+def bench_zero3(smoke: bool = False, batch: int = 4, chunk_mb: int = -1, overlap: int = 1,
                 **cfg_overrides):
     """GPT-2-XL geometry (1.5B), ZeRO-3 + host optimizer offload — the
     BASELINE.md 'DeepSpeed ZeRO-3 plugin equivalent' config.  The fp32 adam
@@ -276,12 +276,11 @@ def bench_zero3(smoke: bool = False, batch: int = 4, chunk_mb: int = -1, overlap
                 offload_optimizer_device="cpu",
                 # adaptive chunk sizing from free HBM (utils/chunked_update.
                 # auto_chunk_bytes): resident working set + a 10% margin leave
-                # ~6 GB on a 16 GB chip, split across the 2-deep in-flight
-                # window at ~4x transients per chunk => ~700 MB chunks.  The
-                # double-buffer (offload_update_overlap=2, the default)
-                # overlaps chunk N's host write-back with chunk N+1's read —
-                # the round-3 config serialized every chunk behind a 1-2 s
-                # tunnel barrier (46 s/step at 1 GB chunks; BENCH_NOTES.md).
+                # ~6 GB on a 16 GB chip for the in-flight window at ~4x
+                # transients per chunk.  overlap=1 (serialized) measured
+                # FASTER than the 2-deep double-buffer on this rig — the
+                # doubled transients thrash the allocator near the HBM limit
+                # (BENCH_NOTES.md round-4 zero3 rows).
                 offload_update_chunk_mb=chunk_mb,
                 offload_update_overlap=overlap,
             ),

@@ -215,17 +215,18 @@ class TestAutoChunkBytes:
 
         # 2.13B-param bf16-working/bf16-grad config on a 16 GB chip (the zero3
         # bench shape): resident ~8.5 GB, margin 1.6 GB -> ~5.9 GB free over
-        # a 2-deep window of 4x transients => ~750 MB chunks.
+        # a serialized window at the swept 6x budget => ~1 GB chunks (the
+        # measured-optimal size; BENCH_NOTES.md round 4).
         params = {"w": jax.ShapeDtypeStruct((2_130_000, 1000), jnp.float32)}
         chunk = auto_chunk_bytes(
             params,
             working_bytes_per_element=2,
             grad_bytes_per_element=2,
             shard_degree=1,
-            overlap=2,
+            overlap=1,
             hbm_bytes=16 << 30,
         )
-        assert (500 << 20) < chunk < (1 << 30)
+        assert (700 << 20) < chunk < (1200 << 20)
 
     def test_sharding_scales_global_chunk(self):
         from accelerate_tpu.utils.chunked_update import auto_chunk_bytes

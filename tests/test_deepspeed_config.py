@@ -1,0 +1,169 @@
+"""DeepSpeed-JSON migration shim (ZeroPlugin.from_deepspeed_config).
+
+Round-trips the reference's own config templates
+(/root/reference/examples/deepspeed_config_templates/) — the file format the
+reference accepts via ``--deepspeed_config_file`` / ``hf_ds_config``
+(reference ``accelerator.py:1617-1745``).
+"""
+
+import json
+import os
+import warnings
+
+import pytest
+
+from accelerate_tpu.utils.dataclasses import ShardingStrategy, ZeroPlugin
+
+TEMPLATES = "/root/reference/examples/deepspeed_config_templates"
+
+needs_templates = pytest.mark.skipif(
+    not os.path.isdir(TEMPLATES), reason="reference templates not present"
+)
+
+
+def _load(name, **overrides):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return ZeroPlugin.from_deepspeed_config(os.path.join(TEMPLATES, name), **overrides)
+
+
+@needs_templates
+class TestReferenceTemplates:
+    def test_stage1(self):
+        p = _load("zero_stage1_config.json")
+        assert p.zero_stage == 1
+        assert p.offload_optimizer_device == "none"
+        assert p.inferred_mixed_precision == "fp16"
+        fsdp = p.to_fsdp_plugin()
+        assert fsdp.sharding_strategy == ShardingStrategy.SHARD_GRAD_OP
+        assert not fsdp.shards_grads  # stage 1: grads stay replicated
+
+    def test_stage2(self):
+        p = _load("zero_stage2_config.json")
+        assert p.zero_stage == 2
+        assert p.gradient_accumulation_steps == 1
+        assert p.to_fsdp_plugin().shards_grads
+
+    def test_stage2_offload(self):
+        p = _load("zero_stage2_offload_config.json")
+        assert p.zero_stage == 2
+        assert p.offload_optimizer_device == "cpu"
+        fsdp = p.to_fsdp_plugin()
+        assert fsdp.offload_optimizer
+
+    def test_stage3(self):
+        p = _load("zero_stage3_config.json")
+        assert p.zero_stage == 3
+        fsdp = p.to_fsdp_plugin()
+        assert fsdp.sharding_strategy == ShardingStrategy.FULL_SHARD
+        assert fsdp.min_weight_size == 0
+
+    def test_stage3_offload(self):
+        p = _load("zero_stage3_offload_config.json")
+        assert p.zero_stage == 3
+        assert p.offload_optimizer_device == "cpu"
+        assert p.offload_param_device == "cpu"
+        # sub_group_size 1e9 elements -> chunked update granularity
+        assert p.offload_update_chunk_mb == int(1e9) * 12 >> 20
+        fsdp = p.to_fsdp_plugin()
+        assert fsdp.offload_optimizer and fsdp.cpu_offload
+
+    def test_unmapped_keys_warn_once(self):
+        with pytest.warns(UserWarning, match="without a TPU-runtime mapping"):
+            ZeroPlugin.from_deepspeed_config(
+                os.path.join(TEMPLATES, "zero_stage2_config.json")
+            )
+
+    def test_overrides_win(self):
+        p = _load("zero_stage2_config.json", zero_stage=3)
+        assert p.zero_stage == 3
+
+
+class TestShimDetails:
+    def test_nvme_offload_maps_to_disk_tier(self, tmp_path):
+        cfg = {
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)},
+            },
+            "bf16": {"enabled": True},
+        }
+        path = tmp_path / "ds.json"
+        path.write_text(json.dumps(cfg))
+        p = ZeroPlugin.from_deepspeed_config(str(path))
+        assert p.offload_optimizer_device == "nvme"
+        assert p.nvme_path == str(tmp_path)
+        assert p.inferred_mixed_precision == "bf16"
+        assert p.to_fsdp_plugin().offload_optimizer_nvme_path == str(tmp_path)
+
+    def test_param_nvme_falls_back_to_cpu_with_warning(self, tmp_path):
+        cfg = {
+            "zero_optimization": {
+                "stage": 3,
+                "offload_param": {"device": "nvme", "nvme_path": str(tmp_path)},
+            },
+        }
+        path = tmp_path / "ds.json"
+        path.write_text(json.dumps(cfg))
+        with pytest.warns(UserWarning, match="offload_param.device='nvme'"):
+            p = ZeroPlugin.from_deepspeed_config(str(path))
+        assert p.offload_param_device == "cpu"
+
+    def test_auto_values_resolve_to_defaults(self, tmp_path):
+        cfg = {
+            "zero_optimization": {"stage": "auto"},
+            "gradient_clipping": "auto",
+            "gradient_accumulation_steps": 4,
+        }
+        path = tmp_path / "ds.json"
+        path.write_text(json.dumps(cfg))
+        p = ZeroPlugin.from_deepspeed_config(str(path))
+        assert p.zero_stage == 2  # field default
+        assert p.gradient_clipping is None
+        assert p.gradient_accumulation_steps == 4
+
+    def test_accelerator_consumes_config(self, tmp_path):
+        import numpy as np
+        import jax.numpy as jnp
+        import optax
+
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        cfg = {
+            "zero_optimization": {"stage": 2},
+            "bf16": {"enabled": True},
+            "gradient_accumulation_steps": 2,
+            "gradient_clipping": 1.0,
+        }
+        path = tmp_path / "ds.json"
+        path.write_text(json.dumps(cfg))
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(deepspeed_plugin=ZeroPlugin.from_deepspeed_config(str(path)))
+        assert acc.gradient_accumulation_steps == 2
+        assert acc.mixed_precision == "bf16"
+        state = acc.create_train_state(
+            params={"w": jnp.ones((8, 8))}, tx=optax.sgd(0.1), seed=0
+        )
+        step = acc.compile_train_step(
+            lambda p, b, rng=None: jnp.mean((b["x"] @ p["w"].astype(jnp.bfloat16)) ** 2)
+        )
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 8)), jnp.bfloat16)
+        state, m = step(state, {"x": x})
+        assert "grad_norm" in m  # gradient_clipping from the JSON engaged
+
+    def test_launcher_env_rebuilds_plugin(self, tmp_path, monkeypatch):
+        cfg = {"zero_optimization": {"stage": 3}, "fp16": {"enabled": True}}
+        path = tmp_path / "ds.json"
+        path.write_text(json.dumps(cfg))
+        monkeypatch.setenv("ACCELERATE_DEEPSPEED_CONFIG_FILE", str(path))
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator()
+        assert acc.state.zero_plugin is not None
+        assert acc.state.zero_plugin.zero_stage == 3
+        assert acc.mixed_precision == "fp16"

@@ -42,6 +42,23 @@ class TestLauncherSelfTest(testing.TempDirTestCase):
         )
         assert "All self-tests passed." in out
         assert "distributed == single-process losses: OK" in out
+        assert "grad sync across accumulate boundary: OK" in out
+
+    def test_debug_mode_shape_mismatch_raises_before_deadlock(self):
+        """ACCELERATE_DEBUG_MODE=1 + a rank-dependent gather shape: operation
+        verification must raise DistributedOperationException on every rank
+        instead of letting the mismatched collective deadlock (reference
+        utils/operations.py:361-421 behavior, across REAL processes)."""
+        env = _env()
+        env["ACCELERATE_DEBUG_MODE"] = "1"
+        with pytest.raises(RuntimeError) as exc:
+            execute_subprocess(
+                launch_cmd(os.path.join(SCRIPTS, "debug_script.py"), num_processes=2),
+                env=env,
+            )
+        out = str(exc.value)
+        assert "DistributedOperationException" in out, out[-2000:]
+        assert "caught mismatch before the collective ran" in out, out[-2000:]
 
     def test_checkpoint_resume_across_processes(self):
         """save mid-epoch in one 2-process run; resume in a FRESH 2-process run;
