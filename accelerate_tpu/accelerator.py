@@ -166,10 +166,12 @@ class Accelerator:
             deepspeed_plugin = ZeroPlugin()
         if (
             mixed_precision is None
+            and not os.environ.get("ACCELERATE_MIXED_PRECISION")
             and deepspeed_plugin is not None
             and getattr(deepspeed_plugin, "inferred_mixed_precision", None)
         ):
-            # the DS JSON's fp16/bf16 section stands in for --mixed_precision
+            # the DS JSON's fp16/bf16 section stands in for --mixed_precision —
+            # but an explicit value (ctor arg or the launcher's env) wins
             mixed_precision = deepspeed_plugin.inferred_mixed_precision
         if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_USE_FSDP"):
             fsdp_plugin = FullyShardedDataParallelPlugin()

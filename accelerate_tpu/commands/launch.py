@@ -399,17 +399,29 @@ def tpu_pod_submit_launcher(args, config) -> int:
             "--submit_tpu_pod needs a zone: pass --tpu_zone or set tpu_zone in "
             "the config file (`accelerate-tpu config`)."
         )
-    config_yaml = yaml.safe_dump(config.to_dict(), default_flow_style=False)
+    cfg_dict = config.to_dict()
+    stage_files = []
+    ds_file = (cfg_dict.get("zero_config") or {}).get("deepspeed_config_file")
+    if ds_file:
+        # the JSON lives on THIS machine; ship its content and repoint the
+        # config at the remote copy (workers open it via from_deepspeed_config)
+        with open(ds_file) as f:
+            ds_content = f.read()
+        remote_ds = "/tmp/accelerate_tpu_submit_ds.json"
+        cfg_dict["zero_config"] = dict(cfg_dict["zero_config"], deepspeed_config_file=remote_ds)
+        stage_files.append((remote_ds, ds_content))
+    config_yaml = yaml.safe_dump(cfg_dict, default_flow_style=False)
     remote_cfg = "/tmp/accelerate_tpu_submit.yaml"
+    stage_files.append((remote_cfg, config_yaml))
     script = " ".join(
         shlex.quote(a)
         for a in (["-m", args.training_script] if args.module else [args.training_script])
         + list(args.training_script_args)
     )
-    command = (
-        f"printf %s {shlex.quote(config_yaml)} > {remote_cfg} && "
-        f"accelerate-tpu launch --config_file {remote_cfg} {script}"
+    stages = " && ".join(
+        f"printf %s {shlex.quote(content)} > {path}" for path, content in stage_files
     )
+    command = f"{stages} && accelerate-tpu launch --config_file {remote_cfg} {script}"
     cmd = build_tpu_command(tpu_name, tpu_zone, [command], use_alpha=args.use_alpha)
     if args.submit_debug:
         print(" ".join(shlex.quote(c) for c in cmd))

@@ -456,11 +456,9 @@ class ZeroPlugin:
     # big_modeling/utils.offload.
 
     def __post_init__(self):
-        # set by from_deepspeed_config when the JSON enables fp16/bf16;
+        # overwritten by from_deepspeed_config when the JSON enables fp16/bf16;
         # consumed by Accelerator when no explicit mixed_precision is given
-        self.inferred_mixed_precision: Optional[str] = getattr(
-            self, "inferred_mixed_precision", None
-        )
+        self.inferred_mixed_precision: Optional[str] = None
         if os.environ.get("ACCELERATE_DEEPSPEED_ZERO_STAGE"):
             self.zero_stage = int(os.environ["ACCELERATE_DEEPSPEED_ZERO_STAGE"])
         if os.environ.get("ACCELERATE_DEEPSPEED_OFFLOAD_OPTIMIZER_DEVICE"):

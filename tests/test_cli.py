@@ -170,6 +170,25 @@ class TestLaunchArgMerging:
         assert "mixed_precision: bf16" in out
         assert "zero_stage: 3" in out
 
+    def test_submit_tpu_pod_ships_deepspeed_json(self, tmp_path, capsys):
+        """A local --deepspeed_config_file must travel WITH the submission:
+        its content is staged to a remote temp file and the shipped config
+        repoints at it (the local path does not exist on pod workers)."""
+        from accelerate_tpu.commands.launch import launch_command
+
+        ds = tmp_path / "ds.json"
+        ds.write_text('{"zero_optimization": {"stage": 3}}')
+        args = self._parse([
+            "--submit_tpu_pod", "my-pod", "--tpu_zone", "us-central2-b",
+            "--submit_debug", "--deepspeed_config_file", str(ds),
+            "train.py",
+        ])
+        launch_command(args)
+        out = capsys.readouterr().out
+        assert "/tmp/accelerate_tpu_submit_ds.json" in out
+        assert "zero_optimization" in out  # the JSON content itself ships
+        assert str(ds) not in out  # the local path never reaches the pod
+
     def test_submit_tpu_pod_requires_zone(self):
         from accelerate_tpu.commands.launch import launch_command
 

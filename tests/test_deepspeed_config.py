@@ -153,6 +153,22 @@ class TestShimDetails:
         state, m = step(state, {"x": x})
         assert "grad_norm" in m  # gradient_clipping from the JSON engaged
 
+    def test_explicit_mixed_precision_env_beats_inferred(self, tmp_path, monkeypatch):
+        """The launcher's ACCELERATE_MIXED_PRECISION (an explicit CLI choice)
+        must win over the JSON's fp16/bf16 section — CLI-over-config
+        precedence."""
+        cfg = {"zero_optimization": {"stage": 2}, "bf16": {"enabled": True}}
+        path = tmp_path / "ds.json"
+        path.write_text(json.dumps(cfg))
+        monkeypatch.setenv("ACCELERATE_MIXED_PRECISION", "no")
+        from accelerate_tpu import Accelerator
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc = Accelerator(deepspeed_plugin=ZeroPlugin.from_deepspeed_config(str(path)))
+        assert acc.mixed_precision == "no"
+
     def test_launcher_env_rebuilds_plugin(self, tmp_path, monkeypatch):
         cfg = {"zero_optimization": {"stage": 3}, "fp16": {"enabled": True}}
         path = tmp_path / "ds.json"
