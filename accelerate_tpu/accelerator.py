@@ -962,11 +962,13 @@ class Accelerator:
     def _powersgd_config(self) -> Optional[Dict[str, int]]:
         """Validated PowerSGD settings, or None when the hook is off.
 
-        The hook runs the backward per-replica under ``shard_map`` over ``dp``
-        (reference ``DDPCommunicationHookType.POWER_SGD`` analog); composing
-        that with sharded-parameter axes would need partial-auto shard_map over
-        every rule in ``parallel/``, so it is restricted to pure-dp meshes —
-        the multi-slice DDP topology the hook exists for.
+        The hook runs the backward per-replica under a partial-auto
+        ``shard_map``: only ``dp`` is a manual axis (reference
+        ``DDPCommunicationHookType.POWER_SGD`` analog), while an ``fsdp``
+        axis — the HYBRID_SHARD multi-slice topology the hook exists for —
+        stays auto, so GSPMD keeps handling the in-replica parameter
+        sharding.  Model-parallel axes (tp/pp/sp/ep) remain rejected: their
+        rules restructure the computation itself, not just placement.
         """
         handler = self.collective_handler
         if handler is None or handler.comm_hook in (None, "none"):
@@ -978,14 +980,20 @@ class Accelerator:
             )
         offending = [
             a for a in self.mesh.axis_names
-            if a != "dp" and mesh_lib.mesh_axis_size(self.mesh, a) > 1
+            if a not in ("dp", "fsdp") and mesh_lib.mesh_axis_size(self.mesh, a) > 1
         ]
         if offending:
             raise ValueError(
                 "comm_hook='powersgd' compresses the dp gradient reduction and "
-                f"requires a pure-dp mesh; this mesh also shards over {offending}. "
-                "Drop the hook or the extra axes (FSDP/TP already shard gradient "
-                "traffic; PowerSGD targets replicated-DP over slow networks)."
+                f"composes with dp/fsdp meshes only; this mesh also shards over "
+                f"{offending}. Drop the hook or the model-parallel axes "
+                "(PowerSGD targets replicated-DP over slow networks)."
+            )
+        if "dp" not in self.mesh.axis_names:
+            raise ValueError(
+                "comm_hook='powersgd' compresses the dp gradient reduction but "
+                "this mesh has no dp axis; add one (e.g. mesh={'dp': n_slices, "
+                "'fsdp': -1}) or drop the hook."
             )
         if self._use_loss_scaling:
             raise ValueError(
@@ -1211,6 +1219,11 @@ class Accelerator:
 
             comm_state entries carry the error buffer with a leading replica
             axis sharded over dp; each shard_map block sees its own slice.
+            The shard_map is PARTIAL-AUTO (``axis_names={"dp"}``): an fsdp
+            axis stays auto, so inside each dp block GSPMD keeps the params,
+            the backward and the compression factors fsdp-sharded — the
+            HYBRID_SHARD composition (in-slice fsdp, compressed dp across the
+            slow network).
             """
             from .parallel.compression import compressed_pmean
 
@@ -1263,6 +1276,7 @@ class Accelerator:
             return jax.shard_map(
                 run,
                 mesh=mesh,
+                axis_names={"dp"},
                 in_specs=(PartitionSpec(), data_spec, rng_spec, entry_specs()),
                 out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec(), entry_specs()),
                 check_vma=False,

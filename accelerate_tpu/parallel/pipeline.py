@@ -301,13 +301,20 @@ def pipeline_lm_loss_fn(
     )
 
     def loss_fn(params, batch, rng=None):
+        # Ragged batches are handled INSIDE forward (prepare_pipeline pads to
+        # the microbatch count before the stack and slices its logits back
+        # before the head), so the norm/lm-head/CE never touch pad rows and
+        # the loss is exactly the unpadded value.  For MoE the pad tokens do
+        # enter the router statistics — the same approximation every
+        # fixed-capacity MoE makes.
+        labels = shift_labels(batch)
         if is_moe:
             logits, aux = forward(params, batch["input_ids"])
-            return cross_entropy_loss(logits, shift_labels(batch)) + (
+            return cross_entropy_loss(logits, labels) + (
                 cfg.router_aux_loss_coef * jnp.mean(aux)
             )
         logits = forward(params, batch["input_ids"])
-        return cross_entropy_loss(logits, shift_labels(batch))
+        return cross_entropy_loss(logits, labels)
 
     loss_fn._pp_aware = True
     return loss_fn
@@ -400,8 +407,14 @@ def _pipeline_1f1b_lm_loss(model, mesh, num_microbatches, axis):
         M = _resolve_num_microbatches(num_microbatches)
         pp = mesh_axis_size(mesh_r, axis)
         b, s = input_ids.shape
-        if b % M:
-            raise ValueError(f"Batch {b} not divisible by {M} microbatches")
+        pad = (-b) % M
+        if pad:
+            # ragged batch: pad rows carry all-ignored labels, so the
+            # globally-normalized CE (and its cotangents) are exactly the
+            # unpadded values
+            input_ids = jnp.pad(input_ids, ((0, pad), (0, 0)))
+            labels = jnp.pad(labels, ((0, pad), (0, 0)), constant_values=-100)
+            b += pad
         stack, p_embed, head, rebuild = _split_params_for_pipeline(cfg, params)
         if cfg.tie_word_embeddings:
             head = dict(head, embed=p_embed)
@@ -628,12 +641,14 @@ def prepare_pipeline(
         mesh_r = _resolve_mesh(mesh)
         M = _resolve_num_microbatches(num_microbatches)
         b, s = input_ids.shape
-        if b % M:
-            raise ValueError(f"Batch {b} not divisible by {M} microbatches")
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // M, s))
+        pad = (-b) % M  # ragged batches pad up; logits sliced back below
+        if pad:
+            input_ids = jnp.pad(input_ids, ((0, pad), (0, 0)))
+        b_p = b + pad
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b_p // M, s))
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         x = embed.apply({"params": p["embed_tokens"]}, input_ids)
-        mbs = x.reshape(M, b // M, s, cfg.hidden_size)
+        mbs = x.reshape(M, b_p // M, s, cfg.hidden_size)
         layer_params = stack_layer_params(p, cfg.num_layers)
         out = pipeline_apply(
             stage_fn, layer_params, mbs, positions, mesh=mesh_r, axis=axis,
@@ -642,7 +657,7 @@ def prepare_pipeline(
         aux = None
         if with_aux:
             out, aux = out
-        x = out.reshape(b, s, cfg.hidden_size)
+        x = out.reshape(b_p, s, cfg.hidden_size)[:b]
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": p["final_norm"]}, x)
         if cfg.tie_word_embeddings:
             # exact monolithic semantics: embed.attend promotes to cfg.dtype

@@ -190,7 +190,9 @@ class CollectiveKwargs(KwargsHandler):
       ``utils/dataclasses.py:105-199``): the backward runs per-replica under
       ``shard_map`` and only rank-``powersgd_rank`` factors ride the network,
       with per-replica error feedback (``parallel/compression.py``).  Built for
-      meshes whose ``dp`` axis crosses DCN; requires a pure-dp mesh.
+      meshes whose ``dp`` axis crosses DCN; composes with an ``fsdp`` axis
+      (partial-auto shard_map — the HYBRID_SHARD topology); model-parallel
+      axes (tp/pp/sp/ep) are rejected.
     """
 
     grad_reduce_dtype: Optional[str] = None  # "bf16" | "fp16" | "fp32" | None (= fp32 carry)

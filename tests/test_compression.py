@@ -168,13 +168,47 @@ class TestPowerSGDTrainStep:
         # error feedback is per-replica: leading axis == dp
         assert state.comm_state["w"]["error"].shape[0] == 4
 
-    def test_powersgd_rejects_sharded_mesh(self):
-        acc = Accelerator(
+    def test_powersgd_composes_with_fsdp(self):
+        """HYBRID_SHARD composition (partial-auto shard_map): a dp2 x fsdp2
+        run must train IDENTICALLY to a dp2-only run on the same global
+        batches — fsdp is placement, not a different computation — and the
+        params must actually shard over fsdp."""
+        from accelerate_tpu import FullyShardedDataParallelPlugin
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        hook = [CollectiveKwargs(comm_hook="powersgd", powersgd_rank=2, comm_hook_min_size=1)]
+        acc_dp = Accelerator(mesh={"dp": 2}, kwargs_handlers=hook)
+        state_dp, step_dp, _ = _quadratic_setup(acc_dp)
+        batch = _batch()
+        for _ in range(4):
+            state_dp, m_dp = step_dp(state_dp, batch)
+
+        GradientState._reset_state()
+        AcceleratorState._reset_state(reset_partial_state=True)
+        acc_h = Accelerator(
             mesh={"dp": 2, "fsdp": 2},
+            fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=0),
+            kwargs_handlers=hook,
+        )
+        state_h, step_h, _ = _quadratic_setup(acc_h)
+        specs = {str(x.sharding.spec) for x in jax.tree_util.tree_leaves(state_h.params)}
+        assert any("fsdp" in s for s in specs), specs
+        for _ in range(4):
+            state_h, m_h = step_h(state_h, batch)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(state_h.params["w"])),
+            np.asarray(jax.device_get(state_dp.params["w"])),
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(float(m_h["loss"]), float(m_dp["loss"]), rtol=1e-4)
+
+    def test_powersgd_rejects_model_parallel_mesh(self):
+        acc = Accelerator(
+            mesh={"dp": 2, "tp": 2},
             kwargs_handlers=[CollectiveKwargs(comm_hook="powersgd")],
         )
         params = {"w": jnp.zeros((32, 16))}
-        with pytest.raises(ValueError, match="pure-dp"):
+        with pytest.raises(ValueError, match="dp/fsdp"):
             acc.create_train_state(params=params, tx=optax.sgd(0.1))
 
     def test_powersgd_rejects_fp16(self):

@@ -118,14 +118,44 @@ class TestPreparePipeline:
         out = fn(params, ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
-    def test_batch_not_divisible_raises(self):
+    def test_ragged_batch_pads_and_matches_monolithic(self):
+        """batch % num_microbatches != 0: the pipeline pads internally and
+        slices the logits back — outputs match the monolithic forward on the
+        real rows (the reference's PiPPy chunks pad the same way)."""
         cfg = TransformerConfig.tiny(num_layers=4, dtype=jnp.float32, param_dtype=jnp.float32)
         model = Transformer(cfg)
-        ids = jnp.ones((6, 8), jnp.int32)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (6, 8)), jnp.int32)
         params = model.init(jax.random.PRNGKey(0), ids)["params"]
         fn = prepare_pipeline(model, params, mesh=make_mesh(4), num_microbatches=4, jit=False)
-        with pytest.raises(ValueError, match="microbatches"):
-            fn(params, ids)
+        out = fn(params, ids)
+        ref = model.apply({"params": params}, ids)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_ragged_batch_loss_matches_monolithic(self):
+        """Training losses on a ragged batch: the pad rows are all-ignored,
+        so the masked CE equals the unpadded monolithic loss — for BOTH
+        schedules."""
+        from accelerate_tpu.models.transformer import lm_loss_fn
+        from accelerate_tpu.parallel import pipeline_lm_loss_fn
+        from accelerate_tpu.parallel.mesh import build_mesh
+
+        cfg = TransformerConfig.tiny(
+            num_layers=4, dtype=jnp.float32, param_dtype=jnp.float32, scan_layers=True
+        )
+        model = Transformer(cfg)
+        ids = jnp.asarray(np.random.default_rng(1).integers(0, cfg.vocab_size, (6, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        batch = {"input_ids": ids}
+        ref = float(lm_loss_fn(model)(params, batch))
+        mesh = build_mesh({"pp": 2})
+        for schedule in ("gpipe", "1f1b"):
+            loss = float(
+                pipeline_lm_loss_fn(model, mesh=mesh, num_microbatches=4, schedule=schedule)(
+                    params, batch
+                )
+            )
+            np.testing.assert_allclose(loss, ref, rtol=1e-5, err_msg=schedule)
 
 
 class TestTrainerIntegration:
