@@ -81,9 +81,13 @@ def get_cluster_input() -> ClusterConfig:
     elif _ask_bool("Use ZeRO-style optimizer/parameter sharding", False):
         zero_config = {
             "zero_stage": _ask("ZeRO stage", "2", int, choices=["0", "1", "2", "3"]),
-            "offload_optimizer_device": _ask("Offload optimizer state to", "none", choices=["none", "cpu"]),
+            "offload_optimizer_device": _ask(
+                "Offload optimizer state to", "none", choices=["none", "cpu", "nvme"]
+            ),
             "offload_param_device": _ask("Offload parameters to", "none", choices=["none", "cpu"]),
         }
+        if zero_config["offload_optimizer_device"] == "nvme":
+            zero_config["nvme_path"] = _ask("NVMe path for the optimizer tier", "/local_nvme")
     if _ask_bool("Use tensor/pipeline model parallelism", False):
         mp_config = {
             "tp_degree": _ask("Tensor-parallel degree", "1", int),

@@ -436,14 +436,26 @@ class DiskChunkStore:
         return d
 
     def write_chunk(self, i: int, subtree: Any) -> Any:
-        """Persist a (device/host) chunk subtree; return it re-mapped from disk."""
+        """Persist a (device/host) chunk subtree; return it re-mapped from disk.
+
+        Writes go to a temp file and ``os.replace`` over the final name: the
+        previous generation's read-mmaps (possibly still referenced by the
+        just-consumed optimizer arrays — CPU backends can zero-copy numpy
+        inputs) keep their old inode alive, where truncating in place
+        (``mode="w+"`` on the existing file) would invalidate their pages and
+        SIGBUS any late access.
+        """
         from .offload import offload_weight
 
         leaves, treedef = jax.tree_util.tree_flatten(subtree)
         d = self._chunk_dir(i)
         index: Dict[str, Dict] = {}
         for j, leaf in enumerate(leaves):
-            offload_weight(np.asarray(leaf), f"leaf_{j}", d, index=index)
+            offload_weight(np.asarray(leaf), f"leaf_{j}__tmp", d, index=index)
+            os.replace(
+                os.path.join(d, f"leaf_{j}__tmp.dat"), os.path.join(d, f"leaf_{j}.dat")
+            )
+            index[f"leaf_{j}"] = index.pop(f"leaf_{j}__tmp")  # keys match files on disk
         self._meta[i] = (treedef, [index[f"leaf_{j}"] for j in range(len(leaves))])
         return self.read_chunk(i)
 

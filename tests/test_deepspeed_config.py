@@ -79,6 +79,40 @@ class TestReferenceTemplates:
         assert p.zero_stage == 3
 
 
+class TestShippedTemplates:
+    """The TPU-adapted templates in examples/deepspeed_config_templates/ must
+    all load warning-free except for documented ignorables."""
+
+    TEMPLATES = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "deepspeed_config_templates",
+    )
+
+    def test_all_templates_load(self):
+        names = [f for f in os.listdir(self.TEMPLATES) if f.endswith(".json")]
+        assert len(names) >= 6
+        for name in names:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                p = ZeroPlugin.from_deepspeed_config(os.path.join(self.TEMPLATES, name))
+            # warning-free is the contract: every key in the shipped templates
+            # must map onto this runtime (unlike the reference's, which carry
+            # optimizer/scheduler/bucket sections the shim warns about)
+            unexpected = [str(w.message) for w in caught]
+            assert not unexpected, (name, unexpected)
+            assert p.inferred_mixed_precision == "bf16", name
+            assert p.gradient_clipping == 1.0, name
+            p.to_fsdp_plugin()
+
+    def test_nvme_template(self):
+        p = ZeroPlugin.from_deepspeed_config(
+            os.path.join(self.TEMPLATES, "zero_stage3_nvme_offload_config.json")
+        )
+        assert p.offload_optimizer_device == "nvme"
+        assert p.nvme_path == "/local_nvme/opt"
+        assert p.offload_update_chunk_mb == int(1e8) * 12 >> 20
+
+
 class TestShimDetails:
     def test_nvme_offload_maps_to_disk_tier(self, tmp_path):
         cfg = {
