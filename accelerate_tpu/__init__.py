@@ -71,6 +71,7 @@ from .utils import (
     ProjectConfiguration,
     ZeroPlugin,
     find_executable_batch_size,
+    optax_from_ds_config,
     release_memory,
 )
 from .utils.random import set_seed

@@ -35,6 +35,7 @@ from .imports import (
     is_transformers_available,
     is_wandb_available,
 )
+from .ds_compat import optax_from_ds_config
 from .operations import (
     ConvertOutputsToFp32,
     DistributedOperationException,

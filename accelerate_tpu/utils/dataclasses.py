@@ -593,8 +593,10 @@ class ZeroPlugin:
         if unmapped:
             warnings.warn(
                 f"DeepSpeed config keys without a TPU-runtime mapping (ignored): "
-                f"{unmapped}. Optimizer/scheduler sections: pass an optax "
-                "transform to create_train_state (and AcceleratedScheduler); "
+                f"{unmapped}. Optimizer/scheduler sections: build the optax "
+                "transform from the SAME file with "
+                "accelerate_tpu.optax_from_ds_config(path, lr=..., "
+                "total_num_steps=...) and pass it to create_train_state; "
                 "comm/bucket tuning is handled by XLA.",
                 stacklevel=2,
             )

@@ -1,0 +1,149 @@
+"""DeepSpeed ``optimizer``/``scheduler`` JSON sections → optax.
+
+Completes the migration shim: :meth:`ZeroPlugin.from_deepspeed_config` maps
+the ZeRO/precision/accumulation keys and WARNS that the optimizer/scheduler
+sections need an optax transform — this module builds that transform from
+the very same sections (reference behavior: DeepSpeed instantiates its fused
+optimizers and LR schedules from these dicts, ``accelerator.py:1617-1745``
+fills the ``"auto"`` values from the Trainer).
+
+Supported (the shapes the reference's own templates use):
+
+- optimizer ``type``: ``Adam``/``AdamW`` (→ ``optax.adamw``; plain Adam is
+  AdamW with weight_decay 0 unless given), ``SGD`` (→ ``optax.sgd``),
+  ``Lamb`` (→ ``optax.lamb``)
+- scheduler ``type``: ``WarmupLR`` (linear warmup, then constant),
+  ``WarmupDecayLR`` (linear warmup, then linear decay to 0 at
+  ``total_num_steps``), ``WarmupCosineLR`` (cosine decay variant)
+
+``"auto"`` values resolve from the keyword arguments, exactly where the
+reference resolves them from the Trainer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Union
+
+import optax
+
+__all__ = ["optax_from_ds_config"]
+
+
+def _resolved(value, fallback, name: str):
+    if value in ("auto", None):
+        if fallback is None:
+            what = 'sets it to "auto"' if value == "auto" else "omits it"
+            raise ValueError(
+                f"DeepSpeed config needs a value for {name} (the config {what}) — "
+                f"pass {name}=... to optax_from_ds_config (the reference fills "
+                "these from the Trainer at prepare() time; here the call site is "
+                "that moment)."
+            )
+        return fallback
+    return value
+
+
+def _schedule(
+    sched: Dict[str, Any], lr: float, total_num_steps: Optional[int],
+    warmup_num_steps: Optional[int],
+):
+    stype = sched.get("type", "WarmupLR")
+    p = sched.get("params", {}) or {}
+    # "auto" warmup must be supplied explicitly, like lr/total_num_steps —
+    # silently resolving it to 0 would drop the warmup the config asks for
+    warmup_steps = int(
+        _resolved(p.get("warmup_num_steps", 0), warmup_num_steps, "warmup_num_steps")
+    )
+    if stype == "WarmupCosineLR":
+        # DeepSpeed's cosine variant speaks RATIOS of the peak lr
+        total = int(_resolved(p.get("total_num_steps"), total_num_steps, "total_num_steps"))
+        min_ratio = float(_resolved(p.get("warmup_min_ratio", 0.0), 0.0, "warmup_min_ratio"))
+        cos_min = float(_resolved(p.get("cos_min_ratio", 0.0), 0.0, "cos_min_ratio"))
+        warmup = optax.linear_schedule(min_ratio * lr, lr, max(warmup_steps, 1))
+        decay = optax.cosine_decay_schedule(lr, max(total - warmup_steps, 1), alpha=cos_min)
+        return optax.join_schedules([warmup, decay], [warmup_steps])
+    min_lr = float(_resolved(p.get("warmup_min_lr", 0.0), 0.0, "warmup_min_lr"))
+    max_lr = float(_resolved(p.get("warmup_max_lr"), lr, "warmup_max_lr"))
+    if stype == "WarmupLR":
+        if warmup_steps == 0:
+            return max_lr
+        return optax.linear_schedule(min_lr, max_lr, warmup_steps)
+    if stype == "WarmupDecayLR":
+        total = int(_resolved(p.get("total_num_steps"), total_num_steps, "total_num_steps"))
+        warmup = optax.linear_schedule(min_lr, max_lr, max(warmup_steps, 1))
+        decay = optax.linear_schedule(max_lr, 0.0, max(total - warmup_steps, 1))
+        return optax.join_schedules([warmup, decay], [warmup_steps])
+    raise ValueError(
+        f"Unsupported DeepSpeed scheduler type {stype!r}; supported: WarmupLR, "
+        "WarmupDecayLR, WarmupCosineLR. Build the optax schedule directly for "
+        "anything else."
+    )
+
+
+def optax_from_ds_config(
+    config: Union[str, Dict[str, Any]],
+    *,
+    lr: Optional[float] = None,
+    weight_decay: Optional[float] = None,
+    total_num_steps: Optional[int] = None,
+    warmup_num_steps: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """Build the optax transform a DeepSpeed JSON's optimizer+scheduler describe.
+
+    ``config`` is the JSON path or the already-parsed dict.  Keyword arguments
+    fill ``"auto"`` values (reference ``deepspeed_config_process`` semantics).
+    Use together with the ZeRO shim::
+
+        plugin = ZeroPlugin.from_deepspeed_config("ds.json")
+        tx = optax_from_ds_config("ds.json", lr=2e-4, total_num_steps=10_000)
+        acc = Accelerator(deepspeed_plugin=plugin)
+        state = acc.create_train_state(params=params, tx=tx)
+    """
+    if isinstance(config, str):
+        with open(config) as f:
+            ds = json.load(f)
+    else:
+        ds = config
+
+    opt = ds.get("optimizer") or {}
+    otype = str(opt.get("type", "AdamW"))
+    p = opt.get("params", {}) or {}
+    lr_val = float(_resolved(p.get("lr"), lr, "lr"))
+    sched = ds.get("scheduler")
+    lr_or_schedule = (
+        _schedule(sched, lr_val, total_num_steps, warmup_num_steps) if sched else lr_val
+    )
+
+    wd_val = float(_resolved(
+        p.get("weight_decay", 0.0),
+        weight_decay if weight_decay is not None else 0.0, "weight_decay",
+    ))
+    # "auto" betas/eps/momentum fill with the Trainer defaults the reference
+    # would supply (adam_beta1/2, adam_epsilon, 0 momentum)
+    betas = _resolved(p.get("betas", (0.9, 0.999)), (0.9, 0.999), "betas")
+    eps = float(_resolved(p.get("eps", 1e-8), 1e-8, "eps"))
+
+    lowered = otype.lower()
+    if lowered in ("adam", "adamw"):
+        return optax.adamw(
+            lr_or_schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+            weight_decay=wd_val,
+        )
+    if lowered == "sgd":
+        momentum = _resolved(p.get("momentum", 0.0), 0.0, "momentum")
+        tx = optax.sgd(lr_or_schedule, momentum=float(momentum) if momentum else None)
+        if wd_val:
+            tx = optax.chain(optax.add_decayed_weights(wd_val), tx)
+        return tx
+    if lowered == "lamb":
+        return optax.lamb(
+            lr_or_schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+            weight_decay=wd_val,
+        )
+    raise ValueError(
+        f"Unsupported DeepSpeed optimizer type {otype!r}; supported: Adam, AdamW, "
+        "SGD, Lamb. Pass an optax transform directly for anything else "
+        "(DeepSpeed's fused/CPU variants are execution details of its CUDA "
+        "engine — the math maps onto these)."
+    )

@@ -79,6 +79,136 @@ class TestReferenceTemplates:
         assert p.zero_stage == 3
 
 
+class TestOptaxFromDsConfig:
+    """DeepSpeed optimizer/scheduler sections -> optax
+    (utils/ds_compat.optax_from_ds_config) — built from the reference's own
+    templates, "auto" values filled at the call site like the reference fills
+    them from the Trainer."""
+
+    @needs_templates
+    def test_reference_template_builds_and_trains(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import optax as _optax
+
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        path = os.path.join(TEMPLATES, "zero_stage2_config.json")
+        tx = optax_from_ds_config(
+            path, lr=5e-2, weight_decay=0.0, total_num_steps=100, warmup_num_steps=5
+        )
+        params = {"w": jnp.zeros((4, 1))}
+        state = tx.init(params)
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.normal(size=(64, 4)), jnp.float32)
+        Y = X @ jnp.asarray(rng.normal(size=(4, 1)), jnp.float32)
+        import jax
+
+        @jax.jit
+        def step(params, state):
+            loss, g = jax.value_and_grad(
+                lambda p: jnp.mean((X @ p["w"] - Y) ** 2)
+            )(params)
+            updates, state = tx.update(g, state, params)
+            return _optax.apply_updates(params, updates), state, loss
+
+        first = None
+        for _ in range(60):
+            params, state, loss = step(params, state)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first / 10, (first, float(loss))
+
+    @needs_templates
+    def test_auto_without_fallback_raises(self):
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        path = os.path.join(TEMPLATES, "zero_stage2_config.json")
+        with pytest.raises(ValueError, match='"auto"'):
+            optax_from_ds_config(path)  # lr is "auto" and no lr= given
+
+    def test_warmup_decay_schedule_shape(self):
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        cfg = {
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+            "scheduler": {
+                "type": "WarmupDecayLR",
+                "params": {
+                    "warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                    "warmup_num_steps": 10, "total_num_steps": 110,
+                },
+            },
+        }
+        tx = optax_from_ds_config(cfg)
+        assert tx is not None
+        # the schedule itself: ramps to max at step 10, decays to ~0 at 110
+        from accelerate_tpu.utils.ds_compat import _schedule
+
+        sched = _schedule(cfg["scheduler"], 1e-3, None, None)
+        assert abs(float(sched(10)) - 1e-3) < 1e-9
+        assert float(sched(0)) < 1e-4
+        assert float(sched(109)) < 2e-5
+
+    def test_sgd_and_unknown_types(self):
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        tx = optax_from_ds_config(
+            {"optimizer": {"type": "SGD", "params": {"lr": 0.1, "momentum": 0.9}}}
+        )
+        assert tx is not None
+        with pytest.raises(ValueError, match="Unsupported DeepSpeed optimizer"):
+            optax_from_ds_config({"optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}}})
+
+    def test_auto_betas_eps_fill_trainer_defaults(self):
+        """HF-Trainer-style configs set betas/eps/momentum to "auto": they
+        must fill with the Trainer defaults, not crash in float()."""
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        tx = optax_from_ds_config({
+            "optimizer": {"type": "AdamW", "params": {
+                "lr": 1e-3, "betas": "auto", "eps": "auto", "weight_decay": "auto"}},
+        }, weight_decay=0.01)
+        assert tx is not None
+        tx2 = optax_from_ds_config(
+            {"optimizer": {"type": "SGD", "params": {"lr": 0.1, "momentum": "auto"}}}
+        )
+        assert tx2 is not None
+
+    def test_auto_warmup_requires_kwarg(self):
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        cfg = {
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "scheduler": {"type": "WarmupLR", "params": {
+                "warmup_min_lr": 0, "warmup_max_lr": 1e-3, "warmup_num_steps": "auto"}},
+        }
+        with pytest.raises(ValueError, match="warmup_num_steps"):
+            optax_from_ds_config(cfg)
+        assert optax_from_ds_config(cfg, warmup_num_steps=10) is not None
+
+    def test_warmup_cosine_speaks_ratios(self):
+        """DeepSpeed's WarmupCosineLR uses warmup_min_ratio/cos_min_ratio (of
+        the peak lr), not absolute lrs — the floor must be honored."""
+        from accelerate_tpu.utils.ds_compat import _schedule
+
+        sched = _schedule(
+            {"type": "WarmupCosineLR", "params": {
+                "warmup_num_steps": 10, "total_num_steps": 110,
+                "warmup_min_ratio": 0.5, "cos_min_ratio": 0.1}},
+            1e-3, None, None,
+        )
+        assert abs(float(sched(0)) - 0.5e-3) < 1e-9       # warmup floor = ratio*lr
+        assert abs(float(sched(10)) - 1e-3) < 1e-9        # peak
+        assert abs(float(sched(10_000)) - 1e-4) < 1e-9    # cosine floor = ratio*lr
+
+    def test_omitted_key_message(self):
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        with pytest.raises(ValueError, match="omits it"):
+            optax_from_ds_config({"optimizer": {"type": "AdamW", "params": {}}})
+
+
 class TestShippedTemplates:
     """The TPU-adapted templates in examples/deepspeed_config_templates/ must
     all load warning-free except for documented ignorables."""
