@@ -1595,9 +1595,11 @@ class Accelerator:
         # (data deps between chunks sharing a sliced leaf are tracked by the
         # arrays themselves), so unbounded async dispatch would let ALL their
         # stream buffers coexist in HBM — the O(opt state) peak this path
-        # exists to avoid.  A window of `overlap` (default 2, the
-        # double-buffer) overlaps chunk N's host write-back with chunk N+1's
-        # host read at peak = overlap * chunk transients.
+        # exists to avoid.  The window is `overlap` wide (default 1,
+        # serialized — measured faster than the 2-deep double-buffer on the
+        # bench rig, see ZeroPlugin.offload_update_overlap); overlap=2
+        # overlaps chunk N's host write-back with chunk N+1's host read at
+        # peak = overlap * chunk transients.
         overlap = max(int(info.get("overlap", 1)), 1)
 
         def _drain(entry):

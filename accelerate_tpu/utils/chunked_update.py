@@ -426,6 +426,21 @@ class DiskChunkStore:
     """
 
     def __init__(self, path: str):
+        # write_chunk serializes leaves via np.asarray: fine on one process
+        # (sharded leaves gather across local devices), but on a multi-host
+        # mesh the remote shards are non-addressable and np.asarray raises
+        # mid-training.  Fail at construction with the actual limitation
+        # instead; multi-host wants per-process shard-local stores (each rank
+        # persisting only its addressable window), which the sharded-window
+        # chunk layout does not implement yet.
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "The nvme optimizer tier (DiskChunkStore) is single-host only: "
+                "chunk persistence gathers leaves with np.asarray, which cannot "
+                "see non-addressable shards on a multi-process mesh. Use "
+                'offload_optimizer_device="cpu" (pinned host) on pods, or shard '
+                "the optimizer state with fsdp so each host's share fits in RAM."
+            )
         os.makedirs(path, exist_ok=True)
         self.path = path
         self._meta: Dict[int, Any] = {}  # chunk -> (treedef, [leaf infos])

@@ -553,9 +553,27 @@ class ZeroPlugin:
         if p_device != "none":
             kwargs["offload_param_device"] = p_device
         sub_group = resolved(zero.get("sub_group_size"))
-        if sub_group is not None and device in ("cpu", "nvme"):
-            # elements -> MB of streamed state at 12 B/element
-            kwargs["offload_update_chunk_mb"] = max(1, int(float(sub_group)) * 12 >> 20)
+        if (
+            sub_group is not None and device in ("cpu", "nvme")
+            and "offload_update_chunk_mb" not in overrides  # explicit override wins below
+        ):
+            # elements -> MB of streamed state at 12 B/element.  DeepSpeed's
+            # default sub_group_size of 1e9 would map to ~11 GB chunks —
+            # with the ~4-6x per-chunk transients that OOMs a 16 GB chip even
+            # though the same config runs fine under DeepSpeed (which streams
+            # element ranges, not whole programs).  Clamp to 2 GB and warn;
+            # `offload_update_chunk_mb=-1` (adaptive) remains the better knob.
+            chunk_mb = max(1, int(float(sub_group)) * 12 >> 20)
+            if chunk_mb > 2048:
+                warnings.warn(
+                    f"sub_group_size={sub_group!r} maps to ~{chunk_mb} MB streamed "
+                    "chunks; clamping to 2048 MB to stay inside HBM transient "
+                    "headroom (set offload_update_chunk_mb explicitly, or -1 for "
+                    "adaptive sizing, to override).",
+                    stacklevel=2,
+                )
+                chunk_mb = 2048
+            kwargs["offload_update_chunk_mb"] = chunk_mb
         save16 = resolved(zero.get("stage3_gather_16bit_weights_on_model_save"))
         if save16 is not None:
             kwargs["zero3_save_16bit_model"] = bool(save16)

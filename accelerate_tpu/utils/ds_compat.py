@@ -9,9 +9,11 @@ fills the ``"auto"`` values from the Trainer).
 
 Supported (the shapes the reference's own templates use):
 
-- optimizer ``type``: ``Adam``/``AdamW`` (→ ``optax.adamw``; plain Adam is
-  AdamW with weight_decay 0 unless given), ``SGD`` (→ ``optax.sgd``),
-  ``Lamb`` (→ ``optax.lamb``)
+- optimizer ``type``: ``AdamW`` (→ ``optax.adamw``, decoupled decay), ``Adam``
+  (→ ``optax.adamw`` by default — DeepSpeed's factory runs FusedAdam with
+  ``adam_w_mode=True``; ``adam_w_mode: false`` / ``torch_adam: true`` select
+  torch Adam's coupled L2 via ``add_decayed_weights``), ``SGD``
+  (→ ``optax.sgd``), ``Lamb`` (→ ``optax.lamb``)
 - scheduler ``type``: ``WarmupLR`` (linear warmup, then constant),
   ``WarmupDecayLR`` (linear warmup, then linear decay to 0 at
   ``total_num_steps``), ``WarmupCosineLR`` (cosine decay variant)
@@ -28,6 +30,8 @@ from typing import Any, Dict, Optional, Union
 import optax
 
 __all__ = ["optax_from_ds_config"]
+
+_MISSING = object()  # distinguishes an absent JSON key from an explicit "auto"
 
 
 def _resolved(value, fallback, name: str):
@@ -50,11 +54,17 @@ def _schedule(
 ):
     stype = sched.get("type", "WarmupLR")
     p = sched.get("params", {}) or {}
-    # "auto" warmup must be supplied explicitly, like lr/total_num_steps —
-    # silently resolving it to 0 would drop the warmup the config asks for
-    warmup_steps = int(
-        _resolved(p.get("warmup_num_steps", 0), warmup_num_steps, "warmup_num_steps")
-    )
+    # An explicit "auto" warmup must be supplied via kwarg, like
+    # lr/total_num_steps — resolving it to a guess would drop the value the
+    # config defers to the Trainer.  A MISSING key is different: it falls
+    # back to the kwarg, then to DeepSpeed's own WarmupLR/WarmupDecayLR
+    # default of 1000 (a migrated config relying on the DS default must not
+    # silently lose its warmup to 0).
+    raw_warmup = p.get("warmup_num_steps", _MISSING)
+    if raw_warmup is _MISSING:
+        warmup_steps = int(warmup_num_steps if warmup_num_steps is not None else 1000)
+    else:
+        warmup_steps = int(_resolved(raw_warmup, warmup_num_steps, "warmup_num_steps"))
     if stype == "WarmupCosineLR":
         # DeepSpeed's cosine variant speaks RATIOS of the peak lr
         total = int(_resolved(p.get("total_num_steps"), total_num_steps, "total_num_steps"))
@@ -125,11 +135,30 @@ def optax_from_ds_config(
     eps = float(_resolved(p.get("eps", 1e-8), 1e-8, "eps"))
 
     lowered = otype.lower()
-    if lowered in ("adam", "adamw"):
+    if lowered == "adamw":
         return optax.adamw(
             lr_or_schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
             weight_decay=wd_val,
         )
+    if lowered == "adam":
+        # DeepSpeed's optimizer factory maps config type "Adam" to FusedAdam
+        # with adam_w_mode=True — DECOUPLED (AdamW-style) decay — unless the
+        # config opts out via adam_w_mode:false or torch_adam:true, in which
+        # case it is torch Adam's COUPLED L2 (grad += wd*param before the
+        # moment updates).  Honor both paths so the migrated update math
+        # matches the DeepSpeed run being reproduced.
+        coupled = bool(
+            _resolved(p.get("torch_adam", False), False, "torch_adam")
+        ) or not bool(_resolved(p.get("adam_w_mode", True), True, "adam_w_mode"))
+        if not coupled:
+            return optax.adamw(
+                lr_or_schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps,
+                weight_decay=wd_val,
+            )
+        tx = optax.adam(lr_or_schedule, b1=float(betas[0]), b2=float(betas[1]), eps=eps)
+        if wd_val:
+            tx = optax.chain(optax.add_decayed_weights(wd_val), tx)
+        return tx
     if lowered == "sgd":
         momentum = _resolved(p.get("momentum", 0.0), 0.0, "momentum")
         tx = optax.sgd(lr_or_schedule, momentum=float(momentum) if momentum else None)

@@ -1,0 +1,56 @@
+import time
+import jax, jax.numpy as jnp
+from accelerate_tpu.ops.flash_attention import flash_attention
+
+B, S, HQ, HKV, D = 4, 2048, 32, 4, 64
+N = 16
+key = jax.random.PRNGKey(0)
+q = jax.random.normal(key, (B, S, HQ, D), jnp.bfloat16)
+k = jax.random.normal(key, (B, S, HKV, D), jnp.bfloat16)
+v = jax.random.normal(key, (B, S, HKV, D), jnp.bfloat16)
+
+def make(mode, blocks):
+    def one(q, k, v):
+        return flash_attention(q, k, v, causal=True, **blocks)
+    if mode == "fwd":
+        body = one
+    else:
+        def body(q, k, v):
+            return jax.grad(lambda a,b,c: one(a,b,c).astype(jnp.float32).sum(), argnums=(0,))(q,k,v)[0]
+    def loop(q, k, v):
+        def step(carry, _):
+            return body(carry, k, v).astype(carry.dtype), ()
+        out, _ = jax.lax.scan(step, q, None, length=N)
+        return out
+    return jax.jit(loop)
+
+def barrier(o):
+    return float(o.reshape(-1)[0].astype(jnp.float32))
+
+fwd_combos = [(32, 512), (64, 512), (64, 256), (128, 256), (32, 1024), (64, 1024)]
+bwd_combos = [(32, 512), (64, 256), (64, 512), (32, 256), (128, 128)]
+
+for bq, bk in fwd_combos:
+    try:
+        f = make("fwd", dict(block_q=bq, block_k=bk))
+        barrier(f(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = f(q, k, v)
+        barrier(out)
+        dt = (time.perf_counter() - t0) / 3 / N
+        print(f"fwd bq={bq:4d} bk={bk:5d}: {dt*1e3:7.2f} ms/layer")
+    except Exception as e:
+        print(f"fwd bq={bq:4d} bk={bk:5d}: FAIL {str(e)[:80]}")
+for bq, bk in bwd_combos:
+    try:
+        f = make("bwd", dict(block_q_bwd=bq, block_k_bwd=bk))
+        barrier(f(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = f(q, k, v)
+        barrier(out)
+        dt = (time.perf_counter() - t0) / 3 / N
+        print(f"bwd bq={bq:4d} bk={bk:5d}: {dt*1e3:7.2f} ms/layer")
+    except Exception as e:
+        print(f"bwd bq={bq:4d} bk={bk:5d}: FAIL {str(e)[:80]}")

@@ -63,8 +63,9 @@ class TestReferenceTemplates:
         assert p.zero_stage == 3
         assert p.offload_optimizer_device == "cpu"
         assert p.offload_param_device == "cpu"
-        # sub_group_size 1e9 elements -> chunked update granularity
-        assert p.offload_update_chunk_mb == int(1e9) * 12 >> 20
+        # sub_group_size 1e9 elements maps to ~11.4 GB at 12 B/element —
+        # clamped to 2 GB so the 4-6x per-chunk transients fit a 16 GB chip
+        assert p.offload_update_chunk_mb == 2048
         fsdp = p.to_fsdp_plugin()
         assert fsdp.offload_optimizer and fsdp.cpu_offload
 
@@ -186,6 +187,89 @@ class TestOptaxFromDsConfig:
         with pytest.raises(ValueError, match="warmup_num_steps"):
             optax_from_ds_config(cfg)
         assert optax_from_ds_config(cfg, warmup_num_steps=10) is not None
+
+    def test_missing_warmup_takes_deepspeed_default(self):
+        """A MISSING warmup_num_steps (config relies on the DS default) must
+        resolve to DeepSpeed's WarmupLR default of 1000, not silently to 0."""
+        from accelerate_tpu.utils.ds_compat import _schedule
+
+        sched = _schedule(
+            {"type": "WarmupLR", "params": {"warmup_max_lr": 1e-3}}, 1e-3, None, None
+        )
+        # still ramping at step 500, at peak by 1000
+        assert float(sched(500)) < 1e-3 * 0.6
+        assert abs(float(sched(1000)) - 1e-3) < 1e-9
+        # the kwarg still wins over the DS default when given
+        sched10 = _schedule(
+            {"type": "WarmupLR", "params": {"warmup_max_lr": 1e-3}}, 1e-3, None, 10
+        )
+        assert abs(float(sched10(10)) - 1e-3) < 1e-9
+
+    def test_adam_weight_decay_matches_deepspeed_dispatch(self):
+        """DeepSpeed maps config type ``Adam`` to FusedAdam(adam_w_mode=True)
+        — DECOUPLED decay — by default, and to torch Adam's COUPLED L2 only
+        under ``adam_w_mode: false`` / ``torch_adam: true``.  The optax
+        mapping must reproduce both paths."""
+        import jax.numpy as jnp
+        import numpy as np
+        import optax as _optax
+
+        from accelerate_tpu.utils.ds_compat import optax_from_ds_config
+
+        wd, lr = 0.1, 1e-2
+        params = {"w": jnp.full((3,), 2.0)}
+        g = {"w": jnp.full((3,), 0.5)}
+
+        def step(tx):
+            updates, _ = tx.update(g, tx.init(params), params)
+            return np.asarray(updates["w"])
+
+        # default: decoupled, identical to adamw
+        default = step(optax_from_ds_config(
+            {"optimizer": {"type": "Adam", "params": {"lr": lr, "weight_decay": wd}}}
+        ))
+        np.testing.assert_allclose(
+            default, step(_optax.adamw(lr, weight_decay=wd)), rtol=1e-6
+        )
+
+        # adam_w_mode:false -> coupled: same step as plain adam fed (g + wd*p)
+        coupled = step(optax_from_ds_config(
+            {"optimizer": {"type": "Adam", "params": {
+                "lr": lr, "weight_decay": wd, "adam_w_mode": False}}}
+        ))
+        ref = _optax.adam(lr)
+        coupled_g = {"w": g["w"] + wd * params["w"]}
+        ref_updates, _ = ref.update(coupled_g, ref.init(params), params)
+        np.testing.assert_allclose(coupled, np.asarray(ref_updates["w"]), rtol=1e-6)
+        assert not np.allclose(default, coupled)
+
+        # torch_adam:true is the other opt-out spelling
+        torch_adam = step(optax_from_ds_config(
+            {"optimizer": {"type": "Adam", "params": {
+                "lr": lr, "weight_decay": wd, "torch_adam": True}}}
+        ))
+        np.testing.assert_allclose(torch_adam, coupled, rtol=1e-6)
+
+    def test_huge_sub_group_size_clamps_with_warning(self):
+        """DeepSpeed's stock sub_group_size=1e9 maps to ~11 GB chunks — must
+        clamp to 2 GB (with a warning) instead of OOMing 16 GB chips."""
+        import json as _json
+        import tempfile
+
+        cfg = {
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu"},
+                "sub_group_size": 1e9,
+            }
+        }
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+            _json.dump(cfg, f)
+            path = f.name
+        with pytest.warns(UserWarning, match="clamping to 2048"):
+            p = ZeroPlugin.from_deepspeed_config(path)
+        assert p.offload_update_chunk_mb == 2048
+        os.unlink(path)
 
     def test_warmup_cosine_speaks_ratios(self):
         """DeepSpeed's WarmupCosineLR uses warmup_min_ratio/cos_min_ratio (of
