@@ -24,6 +24,7 @@ from .data_loader import (
     skip_first_batches,
 )
 from .big_modeling import (
+    StageHook,
     StreamingExecutor,
     StreamingTransformer,
     cpu_offload,
@@ -39,10 +40,14 @@ from .launchers import debug_launcher, notebook_launcher
 from .models import (
     GenerationConfig,
     KVCache,
+    config_from_hf,
+    convert_hf_checkpoint,
     generate,
+    load_hf_checkpoint,
     make_decode_step,
     make_prefill_step,
     sample_tokens,
+    to_scan_layout,
 )
 from .ops import (
     Int4Config,

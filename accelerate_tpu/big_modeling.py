@@ -366,7 +366,16 @@ def load_checkpoint_and_dispatch(
 
     Returns ``(params, device_map, weights_loader)``; disk-mapped tensors are
     NOT copied — the loader reads them zero-copy from the checkpoint itself.
+
+    A raw HF model directory (config.json with a mapped ``model_type``, HF key
+    naming) is auto-converted into ``<dir>/_atpu_native`` first — see
+    :mod:`accelerate_tpu.models.hf_compat` — so a downloaded ``gpt2``/Llama
+    snapshot loads directly.
     """
+    from .models.hf_compat import convert_hf_checkpoint, is_hf_checkpoint
+
+    if os.path.isdir(checkpoint) and is_hf_checkpoint(checkpoint):
+        checkpoint = convert_hf_checkpoint(checkpoint, dtype=dtype)
     files = _checkpoint_files(checkpoint)
     flat_shapes = checkpoint_shapes(checkpoint, files=files)
     quantize_flat = None
@@ -475,6 +484,45 @@ def _read_tensors(files: Dict[str, str], keys, dtype=None) -> Dict[str, np.ndarr
 
 
 # ------------------------------------------------------- streaming executor
+class StageHook:
+    """Public extension protocol for :class:`StreamingExecutor` — the
+    TPU-native analog of the reference's ``ModelHook`` / ``add_hook_to_module``
+    (``/root/reference/src/accelerate/hooks.py:36-217``).
+
+    The reference patches ``nn.Module.forward`` per submodule; here the
+    natural interception point is the **stage boundary** of the streaming
+    plan (everything inside a stage is one fused XLA executable).  Subclass
+    and override any of:
+
+    * :meth:`fetch_weights` — replace where a stage's weights come from (a
+      bespoke offload tier, a pinned-in-HBM cache, decryption, ...).  Return
+      ``None`` to fall through to the executor's params/loader resolution.
+    * :meth:`pre_stage` / :meth:`post_stage` — observe or transform the
+      carry at stage entry/exit (timing, logging, activation edits).  Return
+      ``None`` to keep the carry unchanged; these run at the host-level
+      stage boundary, outside jit, so any python is allowed.
+
+    Attach with ``StreamingExecutor(..., hooks=[...])`` or
+    :meth:`StreamingExecutor.add_hook`.  Hooks run in attach order;
+    ``fetch_weights`` uses the first non-``None`` result.
+
+    See ``examples/by_feature/streaming_hooks.py`` for a worked custom
+    offload policy + stage profiler.
+    """
+
+    def fetch_weights(self, executor: "StreamingExecutor", stage_index: int, source):
+        """Return the stage's host/device param tree, or ``None`` for default."""
+        return None
+
+    def pre_stage(self, executor: "StreamingExecutor", stage_index: int, carry: tuple):
+        """Return a replacement carry tuple, or ``None`` to keep ``carry``."""
+        return None
+
+    def post_stage(self, executor: "StreamingExecutor", stage_index: int, carry: tuple):
+        """Return a replacement carry tuple, or ``None`` to keep ``carry``."""
+        return None
+
+
 class StreamingExecutor:
     """Generic layer-plan streaming forward — the model-agnostic
     ``AlignDevicesHook`` engine (reference ``hooks.py:219-396``) redesigned TPU-first.
@@ -505,12 +553,14 @@ class StreamingExecutor:
         weights_loader=None,
         exec_device=None,
         pack_transfers: bool = True,
+        hooks=None,
     ):
         self.plan = list(plan)
         if not self.plan:
             raise ValueError("StreamingExecutor needs a non-empty plan")
         self.params = params
         self.loader = weights_loader
+        self.hooks = list(hooks) if hooks else []
         self.device = exec_device if exec_device is not None else jax.devices()[0]
         # Pack each host-resident stage into ONE contiguous buffer per dtype
         # before transfer: a decoder layer is ~10 leaves, and 10 small
@@ -524,8 +574,31 @@ class StreamingExecutor:
         # across stages so shared modules (tied embeddings) snapshot once
         self._buffer_registry: Dict[Any, Any] = {}
 
+    # -- hooks -------------------------------------------------------------
+    def add_hook(self, hook: StageHook) -> None:
+        """Append a :class:`StageHook`.  Weights-affecting hooks compose with
+        the packed-transfer cache via leaf identity: returning NEW arrays is
+        picked up automatically; mutating host arrays in place still requires
+        :meth:`invalidate_cache` (same contract as ``params``)."""
+        self.hooks.append(hook)
+
+    def remove_hook(self, hook: StageHook) -> None:
+        self.hooks.remove(hook)
+
+    def _hook_carry(self, method: str, i: int, carry: tuple) -> tuple:
+        for h in self.hooks:
+            out = getattr(h, method)(self, i, carry)
+            if out is not None:
+                carry = out if isinstance(out, tuple) else (out,)
+        return carry
+
     # -- module weight access ---------------------------------------------
-    def _stage_params(self, source):
+    def _stage_params(self, source, stage_index: Optional[int] = None):
+        if stage_index is not None:
+            for h in self.hooks:
+                tree = h.fetch_weights(self, stage_index, source)
+                if tree is not None:
+                    return tree
         if callable(source):
             return source()
         return self._module_params(source)
@@ -606,7 +679,7 @@ class StreamingExecutor:
         mutations require :meth:`invalidate_cache`.  ``transfer_cache`` dedupes
         H2D transfers of the same buffer within one forward (tied modules).
         """
-        tree = self._stage_params(self.plan[i][0])
+        tree = self._stage_params(self.plan[i][0], stage_index=i)
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         host = self.pack_transfers and leaves and not any(
             isinstance(x, jax.Array) for x in leaves
@@ -683,8 +756,10 @@ class StreamingExecutor:
                 # async transfer of stage i+1 issued before stage i computes
                 nxt = self._prepare_stage(i + 1, transfer_cache)
             operand, spec, treedef = current
+            carry = self._hook_carry("pre_stage", i, carry)
             out = self._run_stage(fn, operand, spec, treedef, carry)
             carry = out if isinstance(out, tuple) else (out,)
+            carry = self._hook_carry("post_stage", i, carry)
             current = nxt
         return carry[0] if len(carry) == 1 else carry
 
@@ -717,8 +792,9 @@ class StreamingTransformer(StreamingExecutor):
         weights_loader=None,
         exec_device=None,
         layers_per_stage: int = 1,
+        hooks=None,
     ):
-        from .models.transformer import DecoderLayer, RMSNorm
+        from .models.transformer import DecoderLayer, make_norm
 
         cfg = config
         self.config = config
@@ -755,17 +831,23 @@ class StreamingTransformer(StreamingExecutor):
                 new_vs.append(nv)
             return x, tuple(new_ks), tuple(new_vs)
 
-        def embed_fn(embed_params, ids, positions):
+        def embed_fn(stage_params, ids, positions):
             import flax.linen as nn
 
             embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-            return embed.apply({"params": embed_params}, ids), positions
+            if getattr(cfg, "positional", "rope") == "learned":
+                embed_params, pos_params = stage_params
+                x = embed.apply({"params": embed_params}, ids)
+                pos = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+                return x + pos.apply({"params": pos_params}, positions), positions
+            return embed.apply({"params": stage_params}, ids), positions
 
         def head_fn(stage_params, x, positions):
             import flax.linen as nn
 
             norm_params, head_params = stage_params
-            x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": norm_params}, x)
+            # same norm module the monolithic model uses (rmsnorm or layernorm)
+            x = make_norm(cfg, None).apply({"params": norm_params}, x)
             if cfg.tie_word_embeddings:
                 # exact monolithic semantics: embed.attend promotes to cfg.dtype
                 embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
@@ -782,8 +864,13 @@ class StreamingTransformer(StreamingExecutor):
         self._embed_fn = embed_fn
         self._head_fn = head_fn
         self._cached_layer_fn = cached_layer_fn
+        embed_source = (
+            (lambda: (self._module_params("embed_tokens"), self._module_params("pos_embed")))
+            if getattr(cfg, "positional", "rope") == "learned"
+            else "embed_tokens"
+        )
         plan = make_layer_plan(
-            embed=("embed_tokens", embed_fn),
+            embed=(embed_source, embed_fn),
             layers=[
                 # bind per-chunk via default arg (a bare lambda would late-bind
                 # every stage to the last chunk)
@@ -795,7 +882,10 @@ class StreamingTransformer(StreamingExecutor):
                 head_fn,
             ),
         )
-        super().__init__(plan, params=params, weights_loader=weights_loader, exec_device=exec_device)
+        super().__init__(
+            plan, params=params, weights_loader=weights_loader, exec_device=exec_device,
+            hooks=hooks,
+        )
 
     def invalidate_cache(self) -> None:
         self._stack_cache = None
@@ -876,15 +966,20 @@ class StreamingTransformer(StreamingExecutor):
             nxt = self._prepare_stage(i + 1, transfer_cache) if i + 1 < n else None
             operand, spec, treedef = current
             if i == 0:
-                x, pos = self._run_stage(
-                    self._embed_fn, operand, spec, treedef, (input_ids, positions)
+                carry = self._hook_carry("pre_stage", i, (input_ids, positions))
+                x, pos = self._hook_carry(
+                    "post_stage", i, self._run_stage(self._embed_fn, operand, spec, treedef, carry)
                 )
             elif i == n - 1:
-                logits = self._run_stage(self._head_fn, operand, spec, treedef, (x, pos))
+                carry = self._hook_carry("pre_stage", i, (x, pos))
+                logits = self._run_stage(self._head_fn, operand, spec, treedef, carry)
+                (logits,) = self._hook_carry("post_stage", i, (logits,))
             else:
                 ks, vs = cache["chunks"][i - 1]
-                x, nks, nvs = self._run_stage(
-                    self._cached_layer_fn, operand, spec, treedef, (x, pos, ks, vs, index)
+                carry = self._hook_carry("pre_stage", i, (x, pos, ks, vs, index))
+                x, nks, nvs = self._hook_carry(
+                    "post_stage", i,
+                    self._run_stage(self._cached_layer_fn, operand, spec, treedef, carry),
                 )
                 new_chunks.append((nks, nvs))
             current = nxt

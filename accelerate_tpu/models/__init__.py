@@ -1,6 +1,7 @@
 """models subpackage."""
 
 from .generation import GenerationConfig, generate, make_decode_step, make_prefill_step, sample_tokens
+from .hf_compat import config_from_hf, convert_hf_checkpoint, load_hf_checkpoint, to_scan_layout
 from .transformer import KVCache, Transformer, TransformerConfig, cross_entropy_loss, lm_loss_fn
 
 __all__ = [
@@ -8,10 +9,14 @@ __all__ = [
     "KVCache",
     "Transformer",
     "TransformerConfig",
+    "config_from_hf",
+    "convert_hf_checkpoint",
     "cross_entropy_loss",
     "generate",
     "lm_loss_fn",
+    "load_hf_checkpoint",
     "make_decode_step",
     "make_prefill_step",
     "sample_tokens",
+    "to_scan_layout",
 ]

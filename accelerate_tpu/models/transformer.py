@@ -100,6 +100,14 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
+    # Architecture family switches (models/hf_compat.py maps real HF
+    # checkpoints onto these): the Llama recipe is the default; GPT-2 is
+    # norm_type="layernorm" + use_bias=True + positional="learned" +
+    # mlp_variant="gelu" + tie_word_embeddings=True.
+    norm_type: str = "rmsnorm"         # "rmsnorm" | "layernorm" (centered, with bias)
+    use_bias: bool = False             # biases on attention/MLP projections
+    positional: str = "rope"           # "rope" | "learned" (wpe-style table)
+    mlp_variant: str = "swiglu"        # "swiglu" | "gelu" (fc -> gelu_new -> proj)
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False                # jax.checkpoint each layer
@@ -153,6 +161,18 @@ class TransformerConfig:
                 f"Unknown ring_attention_layout {self.ring_attention_layout!r}; "
                 "choose 'contiguous' or 'zigzag'"
             )
+        if self.norm_type not in ("rmsnorm", "layernorm"):
+            raise ValueError(
+                f"Unknown norm_type {self.norm_type!r}; choose 'rmsnorm' or 'layernorm'"
+            )
+        if self.positional not in ("rope", "learned"):
+            raise ValueError(
+                f"Unknown positional {self.positional!r}; choose 'rope' or 'learned'"
+            )
+        if self.mlp_variant not in ("swiglu", "gelu"):
+            raise ValueError(
+                f"Unknown mlp_variant {self.mlp_variant!r}; choose 'swiglu' or 'gelu'"
+            )
 
     @classmethod
     def llama2_7b(cls, **kw):
@@ -165,6 +185,18 @@ class TransformerConfig:
         return cls(**{**dict(vocab_size=50257, hidden_size=1600, intermediate_size=6400,
                              num_layers=48, num_heads=25, num_kv_heads=25,
                              max_seq_len=1024), **kw})
+
+    @classmethod
+    def gpt2(cls, **kw):
+        """Real GPT-2 architecture (124M): layernorm+bias, learned positions,
+        gelu MLP, tied embeddings — the checkpoint-interop target
+        (models/hf_compat.py builds larger family members from config.json)."""
+        return cls(**{**dict(
+            vocab_size=50257, hidden_size=768, intermediate_size=3072,
+            num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=1024,
+            norm_type="layernorm", use_bias=True, positional="learned",
+            mlp_variant="gelu", tie_word_embeddings=True,
+        ), **kw})
 
     @classmethod
     def tiny(cls, **kw):
@@ -265,6 +297,32 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
+class LayerNorm(nn.Module):
+    """Centered layernorm with bias (GPT-2 family): fp32 statistics regardless
+    of activation dtype, matching torch ``nn.LayerNorm`` numerics."""
+
+    eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), self.param_dtype)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        normed = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        return (normed * scale + bias).astype(x.dtype)
+
+
+def make_norm(cfg: "TransformerConfig", name: str):
+    """The config-selected norm module — single source for DecoderLayer, the
+    final norm, and big_modeling's streaming head stage."""
+    if cfg.norm_type == "layernorm":
+        return LayerNorm(cfg.rms_norm_eps, cfg.param_dtype, name=name)
+    return RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name=name)
+
+
 class Attention(nn.Module):
     config: TransformerConfig
 
@@ -284,8 +342,9 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.num_heads, hd)
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
         v = v.reshape(b, s, cfg.num_kv_heads, hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.positional == "rope":
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
         if cache is not None:
             k_cache, v_cache, index = cache
             k_cache = jax.lax.dynamic_update_slice(
@@ -321,6 +380,7 @@ def functools_partial_dense(cfg: TransformerConfig):
                 bits=cfg.quantization,
                 block_size=cfg.quantization_block_size,
                 dtype=cfg.dtype,
+                use_bias=cfg.use_bias,
                 name=name,
             )
 
@@ -338,7 +398,7 @@ def functools_partial_dense(cfg: TransformerConfig):
     def make(name: str, features: int):
         return nn.Dense(
             features,
-            use_bias=False,
+            use_bias=cfg.use_bias,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02),
@@ -356,6 +416,11 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = functools_partial_dense(cfg)
+        if cfg.mlp_variant == "gelu":
+            # GPT-2 family: fc -> gelu_new (tanh approximation, which flax's
+            # approximate gelu reproduces) -> proj
+            up = _tag_proj(dense("up_proj", cfg.intermediate_size)(x), "proj_wide")
+            return _tag_proj(dense("down_proj", cfg.hidden_size)(nn.gelu(up, approximate=True)))
         gate = _tag_proj(dense("gate_proj", cfg.intermediate_size)(x))
         up = _tag_proj(dense("up_proj", cfg.intermediate_size)(x), "proj_wide")
         return _tag_proj(dense("down_proj", cfg.hidden_size)(nn.silu(gate) * up))
@@ -368,7 +433,7 @@ class DecoderLayer(nn.Module):
     def __call__(self, x, positions, cache=None):
         cfg = self.config
         attn_out = Attention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x), positions,
+            make_norm(cfg, "input_norm")(x), positions,
             cache=cache,
         )
         new_kv = None
@@ -381,7 +446,7 @@ class DecoderLayer(nn.Module):
             mlp = MoEMLP(cfg, name="moe_mlp")
         else:
             mlp = MLP(cfg, name="mlp")
-        x = x + mlp(RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x))
+        x = x + mlp(make_norm(cfg, "post_attn_norm")(x))
         return x if cache is None else (x, new_kv)
 
 
@@ -414,6 +479,16 @@ class Transformer(nn.Module):
             name="embed_tokens",
         )
         x = embed(input_ids)
+        if cfg.positional == "learned":
+            pos_embed = nn.Embed(
+                cfg.max_seq_len,
+                cfg.hidden_size,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                embedding_init=nn.initializers.normal(0.02),
+                name="pos_embed",
+            )
+            x = x + pos_embed(positions)
         if cfg.attention_impl == "ring":
             x = _constrain_sequence_parallel(x)
 
@@ -463,7 +538,7 @@ class Transformer(nn.Module):
                     index=cache.index + input_ids.shape[1],
                 )
 
-        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        x = make_norm(cfg, "final_norm")(x)
         if cfg.tie_word_embeddings:
             logits = embed.attend(x.astype(cfg.param_dtype))
         else:

@@ -41,6 +41,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import time
 
 import jax
@@ -84,35 +85,67 @@ def main():
                         help="stream int-quantized weights")
     parser.add_argument("--layers_per_stage", type=int, default=None,
                         help="layers streamed per chunk (default: ~6 chunks)")
+    parser.add_argument("--checkpoint", type=str, default=None,
+                        help="REAL checkpoint dir (raw HF gpt2/llama snapshot or "
+                             "converted native): streams actual weights instead "
+                             "of a synthetic preset")
     args = parser.parse_args()
 
     from accelerate_tpu import StreamingTransformer
     from accelerate_tpu.models.transformer import Transformer, TransformerConfig
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    # Default: "small" (~0.53 GB) even on TPU — through the tunneled transport a
-    # single gpt2-xl (4.25 GB) weight stream plus its ~14 remote stage
-    # compiles exceeds half an hour, which no bench budget survives.  The
-    # measured metric (stream GB/s, s/token) is model-size-normalized; run
-    # `--preset gpt2-xl` explicitly on rigs with direct PCIe/DMA host links.
-    preset = args.preset or ("small" if on_tpu else "tiny")
-    cfg = presets[preset](dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
-    seq = min(args.seq, cfg.max_seq_len)
-    model = Transformer(cfg)
+    if args.checkpoint is not None:
+        # real-weights path: HF-dir auto-convert (models/hf_compat) + host load
+        from accelerate_tpu.big_modeling import _checkpoint_files, _read_tensors
+        from accelerate_tpu.models.hf_compat import (
+            config_from_hf, convert_hf_checkpoint, is_hf_checkpoint,
+        )
+        from accelerate_tpu.utils.modeling import unflatten_tree
 
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (args.batch, seq)).astype(np.int32)
+        ckpt = args.checkpoint
+        if is_hf_checkpoint(ckpt):
+            cfg = config_from_hf(ckpt, dtype=jnp.bfloat16)
+            ckpt = convert_hf_checkpoint(ckpt, dtype=jnp.bfloat16)
+        elif os.path.isfile(os.path.join(ckpt, "atpu_conversion.json")):
+            # already-converted native dir: the stamp carries the source config
+            cfg = config_from_hf(ckpt, dtype=jnp.bfloat16)
+        else:
+            raise SystemExit(
+                f"--checkpoint {ckpt}: neither a supported raw HF model dir nor "
+                "a converted _atpu_native dir"
+            )
+        files = _checkpoint_files(ckpt)
+        params = unflatten_tree(_read_tensors(files, list(files)))  # host numpy
+        preset = f"checkpoint:{os.path.basename(os.path.abspath(args.checkpoint))}"
+        model = Transformer(cfg)
+        seq = min(args.seq, cfg.max_seq_len)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (args.batch, seq)).astype(np.int32)
+    else:
+        # Default: "small" (~0.53 GB) even on TPU — through the tunneled transport a
+        # single gpt2-xl (4.25 GB) weight stream plus its ~14 remote stage
+        # compiles exceeds half an hour, which no bench budget survives.  The
+        # measured metric (stream GB/s, s/token) is model-size-normalized; run
+        # `--preset gpt2-xl` explicitly on rigs with direct PCIe/DMA host links.
+        preset = args.preset or ("small" if on_tpu else "tiny")
+        cfg = presets[preset](dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+        seq = min(args.seq, cfg.max_seq_len)
+        model = Transformer(cfg)
 
-    # abstract init, then materialize straight to HOST numpy — the weights
-    # must not be HBM-resident for this benchmark to mean anything.
-    params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), jnp.ones((1, seq), jnp.int32)))["params"]
-    leaves, treedef = jax.tree_util.tree_flatten(params)
-    host_leaves = []
-    for i, leaf in enumerate(leaves):
-        # cheap deterministic host-side init (no device round-trip for huge models)
-        r = np.random.default_rng(i)
-        host_leaves.append((r.standard_normal(leaf.shape, dtype=np.float32) * 0.02).astype(jnp.bfloat16))
-    params = jax.tree_util.tree_unflatten(treedef, host_leaves)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (args.batch, seq)).astype(np.int32)
+
+        # abstract init, then materialize straight to HOST numpy — the weights
+        # must not be HBM-resident for this benchmark to mean anything.
+        params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), jnp.ones((1, seq), jnp.int32)))["params"]
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        host_leaves = []
+        for i, leaf in enumerate(leaves):
+            # cheap deterministic host-side init (no device round-trip for huge models)
+            r = np.random.default_rng(i)
+            host_leaves.append((r.standard_normal(leaf.shape, dtype=np.float32) * 0.02).astype(jnp.bfloat16))
+        params = jax.tree_util.tree_unflatten(treedef, host_leaves)
 
     stream_cfg = cfg
     if args.bits is not None:

@@ -428,6 +428,116 @@ class TestStreamingExecutor:
         assert 0.5 * float(jnp.abs(p_ref - p_got).sum(-1).mean()) < 0.05
 
 
+class TestStageHooks:
+    """Public StageHook extension protocol (reference ModelHook /
+    add_hook_to_module, hooks.py:36-217): weights-fetch override +
+    pre/post-stage carry interception at the streaming stage boundary."""
+
+    def _plan(self):
+        from accelerate_tpu import make_layer_plan
+
+        def fn(p, x):
+            return x @ p["w"]
+
+        params = {
+            "stem": {"w": np.eye(4, dtype=np.float32)},
+            "mid": {"w": 2.0 * np.eye(4, dtype=np.float32)},
+            "out": {"w": np.eye(4, dtype=np.float32)},
+        }
+        plan = make_layer_plan(embed=("stem", fn), layers=[("mid", fn)], head=("out", fn))
+        return plan, params
+
+    def test_pre_post_stage_observe_and_order(self):
+        from accelerate_tpu import StageHook, StreamingExecutor
+
+        calls = []
+
+        class Recorder(StageHook):
+            def __init__(self, tag):
+                self.tag = tag
+
+            def pre_stage(self, ex, i, carry):
+                calls.append((self.tag, "pre", i))
+
+            def post_stage(self, ex, i, carry):
+                calls.append((self.tag, "post", i))
+
+        plan, params = self._plan()
+        ex = StreamingExecutor(plan, params=params, hooks=[Recorder("a")])
+        ex.add_hook(Recorder("b"))
+        out = ex(jnp.ones((1, 4)))
+        np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones((1, 4)))
+        assert calls == [
+            ("a", "pre", 0), ("b", "pre", 0), ("a", "post", 0), ("b", "post", 0),
+            ("a", "pre", 1), ("b", "pre", 1), ("a", "post", 1), ("b", "post", 1),
+            ("a", "pre", 2), ("b", "pre", 2), ("a", "post", 2), ("b", "post", 2),
+        ]
+
+    def test_carry_transform(self):
+        from accelerate_tpu import StageHook, StreamingExecutor
+
+        class Doubler(StageHook):
+            def post_stage(self, ex, i, carry):
+                if i == 0:
+                    return tuple(2.0 * c for c in carry)
+
+        plan, params = self._plan()
+        out = StreamingExecutor(plan, params=params, hooks=[Doubler()])(jnp.ones((1, 4)))
+        np.testing.assert_allclose(np.asarray(out), 4.0 * np.ones((1, 4)))
+
+    def test_fetch_weights_override(self):
+        """A bespoke offload policy: the hook serves one stage's weights from
+        its own store; other stages fall through to the executor's params."""
+        from accelerate_tpu import StageHook, StreamingExecutor
+
+        class CustomStore(StageHook):
+            def __init__(self):
+                self.fetched = []
+
+            def fetch_weights(self, ex, i, source):
+                self.fetched.append((i, source))
+                if source == "mid":
+                    return {"w": 5.0 * np.eye(4, dtype=np.float32)}
+
+        plan, params = self._plan()
+        store = CustomStore()
+        out = StreamingExecutor(plan, params=params, hooks=[store])(jnp.ones((1, 4)))
+        np.testing.assert_allclose(np.asarray(out), 5.0 * np.ones((1, 4)))
+        assert [i for i, _ in store.fetched] == [0, 1, 2]
+
+    def test_remove_hook(self):
+        from accelerate_tpu import StageHook, StreamingExecutor
+
+        class Boom(StageHook):
+            def pre_stage(self, ex, i, carry):
+                raise AssertionError("should have been removed")
+
+        plan, params = self._plan()
+        ex = StreamingExecutor(plan, params=params)
+        h = Boom()
+        ex.add_hook(h)
+        ex.remove_hook(h)
+        np.testing.assert_allclose(np.asarray(ex(jnp.ones((1, 4)))), 2.0 * np.ones((1, 4)))
+
+    def test_hooks_on_cached_decode_path(self):
+        """forward_with_cache runs the same hook protocol (per-stage, in
+        order) — the decode hot loop is observable too."""
+        from accelerate_tpu import StageHook, StreamingTransformer
+
+        cfg, model, params = tiny_params()
+        seen = []
+
+        class Span(StageHook):
+            def pre_stage(self, ex, i, carry):
+                seen.append(i)
+
+        streamer = StreamingTransformer(cfg, params, hooks=[Span()])
+        ids = jnp.asarray(np.arange(4)[None, :], jnp.int32)
+        cache = streamer.init_cache(1, 8)
+        streamer.forward_with_cache(ids, cache)
+        assert seen == list(range(len(streamer.plan)))
+
+
 class TestStreamingTransformer:
     def test_matches_monolithic_forward(self):
         cfg, model, params = tiny_params()

@@ -109,6 +109,12 @@ def test_feature_big_model_inference():
     assert "host-streamed" in out
 
 
+def test_feature_streaming_hooks():
+    out = run_example("by_feature/streaming_hooks.py")
+    assert "streaming_hooks example: OK" in out
+    assert "pinned-cache hits: 4" in out
+
+
 def test_feature_profiler(tmp_path):
     out = run_example("by_feature/profiler.py", "--project_dir", str(tmp_path))
     assert "profile captured" in out

@@ -1,0 +1,239 @@
+"""Real HF-checkpoint interop: key mapping + logits parity vs torch transformers.
+
+The reference's flagship capability is loading actual HF checkpoints
+(``/root/reference/src/accelerate/utils/modeling.py:1608-1830``).  These tests
+build REAL HF-format checkpoints (torch ``save_pretrained`` — genuine GPT-2 /
+Llama key naming, Conv1D vs Linear layouts, tied embeddings, safetensors and
+torch-bin serialization) and assert the converted flax model reproduces the
+torch implementation's logits.  The rig has no network egress, so weights are
+randomly initialized — parity over random weights exercises every mapped
+tensor (any wrong split/transpose/norm placement shows up as divergence).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from accelerate_tpu.models.hf_compat import (
+    config_from_hf,
+    convert_hf_checkpoint,
+    is_hf_checkpoint,
+    load_hf_checkpoint,
+)
+from accelerate_tpu.models.transformer import Transformer
+
+
+def _save_tiny_gpt2(tmp_path, safe_serialization=True):
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=safe_serialization)
+    return model
+
+
+def _save_tiny_llama(tmp_path, tie=False):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=tie,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def _flax_logits(checkpoint, ids: np.ndarray) -> np.ndarray:
+    cfg = config_from_hf(checkpoint, dtype=jnp.float32, param_dtype=jnp.float32)
+    native = convert_hf_checkpoint(checkpoint)
+    from accelerate_tpu.big_modeling import checkpoint_shapes, _checkpoint_files, _read_tensors
+    from accelerate_tpu.utils.modeling import unflatten_tree
+
+    files = _checkpoint_files(native)
+    params = unflatten_tree(_read_tensors(files, list(files)))
+    model = Transformer(cfg)
+    return np.asarray(model.apply({"params": params}, jnp.asarray(ids)))
+
+
+def _torch_logits(model, ids: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        return model(torch.from_numpy(ids)).logits.float().numpy()
+
+
+class TestGPT2Parity:
+    def test_logits_match_torch(self, tmp_path):
+        model = _save_tiny_gpt2(tmp_path)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, size=(2, 17)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    def test_torch_bin_serialization(self, tmp_path):
+        """Old-style pytorch_model.bin shards go through the same mapping."""
+        model = _save_tiny_gpt2(tmp_path, safe_serialization=False)
+        ids = np.arange(10, dtype=np.int64)[None, :]
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    def test_config_mapping(self, tmp_path):
+        _save_tiny_gpt2(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.norm_type == "layernorm"
+        assert cfg.positional == "learned"
+        assert cfg.mlp_variant == "gelu"
+        assert cfg.use_bias and cfg.tie_word_embeddings
+        assert cfg.intermediate_size == 4 * 64
+
+
+class TestLlamaParity:
+    def test_logits_match_torch_gqa(self, tmp_path):
+        model = _save_tiny_llama(tmp_path)
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 128, size=(2, 13)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    def test_tied_embeddings(self, tmp_path):
+        model = _save_tiny_llama(tmp_path, tie=True)
+        ids = np.arange(8, dtype=np.int64)[None, :]
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+class TestDispatchIntegration:
+    def test_auto_detect_and_dispatch(self, tmp_path):
+        """load_checkpoint_and_dispatch pointed at the RAW HF dir: detects,
+        converts (cached), places, and the placed tree runs the model."""
+        from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+
+        model_t = _save_tiny_gpt2(tmp_path)
+        assert is_hf_checkpoint(str(tmp_path))
+        cfg = config_from_hf(str(tmp_path), dtype=jnp.float32, param_dtype=jnp.float32)
+        model = Transformer(cfg)
+        params, device_map, loader = load_checkpoint_and_dispatch(
+            model, str(tmp_path), device_map="auto", max_memory={0: 1 << 30}
+        )
+        assert set(device_map) == set(params)
+        assert set(device_map.values()) == {0}
+        ids = np.arange(9, dtype=np.int64)[None, :]
+        logits = model.apply({"params": params}, jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(logits), _torch_logits(model_t, ids), rtol=2e-4, atol=2e-4
+        )
+        # conversion is cached: second call reuses _atpu_native
+        stamp = os.path.join(str(tmp_path), "_atpu_native", "atpu_conversion.json")
+        mtime = os.path.getmtime(stamp)
+        load_checkpoint_and_dispatch(model, str(tmp_path), device_map="auto")
+        assert os.path.getmtime(stamp) == mtime
+
+    def test_load_hf_checkpoint_streaming(self, tmp_path):
+        """The one-call flow feeds StreamingTransformer (the big-model
+        inference engine) and matches the monolithic logits."""
+        from accelerate_tpu.big_modeling import StreamingTransformer
+
+        model_t = _save_tiny_gpt2(tmp_path)
+        model, params, device_map, loader = load_hf_checkpoint(
+            str(tmp_path),
+            device_map={"embed_tokens": "cpu", "pos_embed": "cpu",
+                        "layers_0": "cpu", "layers_1": "cpu", "final_norm": "cpu"},
+            config_overrides=dict(dtype=jnp.float32, param_dtype=jnp.float32),
+        )
+        streamer = StreamingTransformer(
+            model.config, params, device_map=device_map, weights_loader=loader
+        )
+        ids = np.arange(7, dtype=np.int64)[None, :]
+        logits = streamer(jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(logits), _torch_logits(model_t, ids), rtol=2e-4, atol=2e-4
+        )
+
+    def test_unsupported_arch_raises(self, tmp_path):
+        with open(os.path.join(tmp_path, "config.json"), "w") as f:
+            json.dump({"model_type": "mamba"}, f)
+        assert not is_hf_checkpoint(str(tmp_path))
+        with pytest.raises(NotImplementedError, match="mamba"):
+            config_from_hf(str(tmp_path))
+
+
+class TestScanLayout:
+    def test_restacked_params_match(self, tmp_path):
+        """Converted layers_{i} layout restacks into scan_layers=True and
+        reproduces the same logits — the fine-tune-a-real-checkpoint path."""
+        import dataclasses
+
+        from accelerate_tpu.big_modeling import _checkpoint_files, _read_tensors
+        from accelerate_tpu.models.hf_compat import to_scan_layout
+        from accelerate_tpu.utils.modeling import unflatten_tree
+
+        model_t = _save_tiny_gpt2(tmp_path)
+        cfg = config_from_hf(str(tmp_path), dtype=jnp.float32, param_dtype=jnp.float32)
+        native = convert_hf_checkpoint(str(tmp_path))
+        files = _checkpoint_files(native)
+        params = unflatten_tree(_read_tensors(files, list(files)))
+        scan_params = to_scan_layout(params, cfg.num_layers)
+        scan_cfg = dataclasses.replace(cfg, scan_layers=True)
+        ids = np.arange(11, dtype=np.int64)[None, :]
+        logits = Transformer(scan_cfg).apply({"params": scan_params}, jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(logits), _torch_logits(model_t, ids), rtol=2e-4, atol=2e-4
+        )
+
+
+class TestSharding:
+    def test_reconversion_clears_stale_outputs(self, tmp_path):
+        """A multi-shard conversion followed by a single-shard re-conversion
+        must not leave the old index.json shadowing the new model.safetensors
+        (checkpoint discovery prefers the index)."""
+        from accelerate_tpu.big_modeling import _checkpoint_files
+
+        _save_tiny_gpt2(tmp_path)
+        out = str(tmp_path / "native")
+        convert_hf_checkpoint(str(tmp_path), out_dir=out, max_shard_bytes=64 << 10)
+        assert os.path.isfile(os.path.join(out, "model.safetensors.index.json"))
+        convert_hf_checkpoint(str(tmp_path), out_dir=out, force=True)  # default: 1 shard
+        assert not os.path.isfile(os.path.join(out, "model.safetensors.index.json"))
+        files = _checkpoint_files(out)
+        assert set(files.values()) == {os.path.join(out, "model.safetensors")}
+        assert not [f for f in os.listdir(out) if f.endswith(".part")]
+
+    def test_config_from_converted_dir(self, tmp_path):
+        """The conversion stamp carries the source config: a native dir alone
+        (no raw HF snapshot around) rebuilds the TransformerConfig."""
+        _save_tiny_gpt2(tmp_path)
+        out = convert_hf_checkpoint(str(tmp_path), out_dir=str(tmp_path / "native"))
+        cfg = config_from_hf(out)
+        assert cfg.norm_type == "layernorm" and cfg.num_layers == 2
+
+    def test_conversion_shards_and_bf16(self, tmp_path):
+        """Tiny max_shard_bytes forces the sharded+index output path; bf16
+        cast halves the bytes en route."""
+        _save_tiny_gpt2(tmp_path)
+        out = convert_hf_checkpoint(
+            str(tmp_path), out_dir=str(tmp_path / "sharded"),
+            dtype=jnp.bfloat16, max_shard_bytes=64 << 10,
+        )
+        index = os.path.join(out, "model.safetensors.index.json")
+        assert os.path.isfile(index)
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        assert len(set(weight_map.values())) > 1
+        from safetensors import safe_open
+
+        fname = weight_map["embed_tokens.embedding"]
+        with safe_open(os.path.join(out, fname), framework="np") as f:
+            t = f.get_tensor("embed_tokens.embedding")
+        assert t.dtype == jnp.bfloat16
