@@ -156,6 +156,14 @@ class Accelerator:
                 self.fp8_recipe_handler = handler
         if self.fp8_recipe_handler is None and mixed_precision == "fp8":
             self.fp8_recipe_handler = FP8RecipeKwargs()
+        if self.collective_handler is None and any(
+            os.environ.get(k)
+            for k in ("ACCELERATE_GRAD_REDUCE_DTYPE", "ACCELERATE_COMM_HOOK",
+                      "ACCELERATE_POWERSGD_RANK")
+        ):
+            # launcher-serialized comm tuning (questionnaire comm_config
+            # block); an explicitly passed handler took the branch above
+            self.collective_handler = CollectiveKwargs.from_env()
 
         if deepspeed_plugin is None and os.environ.get("ACCELERATE_DEEPSPEED_CONFIG_FILE"):
             # launcher --deepspeed_config_file: DeepSpeed-JSON migration shim
@@ -216,7 +224,7 @@ class Accelerator:
         if split_batches:
             self.dataloader_config.split_batches = True
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
-        self.compilation_config = compilation_config or CompilationConfig()
+        self.compilation_config = compilation_config or CompilationConfig.from_env()
         # FSDP activation_checkpointing / ModelParallel recompute_activations
         # lower onto the one remat mechanism (jax.checkpoint over the loss).
         wants_remat = (

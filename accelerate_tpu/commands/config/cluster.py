@@ -76,25 +76,87 @@ def get_cluster_input() -> ClusterConfig:
             ),
             "offload_params": _ask_bool("Offload parameters to host memory", False),
             "min_num_params": _ask("Minimum parameter count for sharding a weight", "0", int),
+            "state_dict_type": _ask(
+                "Checkpoint state-dict type", "SHARDED_STATE_DICT",
+                choices=["SHARDED_STATE_DICT", "FULL_STATE_DICT"],
+            ),
             "activation_checkpointing": _ask_bool("Enable activation checkpointing", False),
         }
+        if _ask_bool("Offload optimizer state to host memory", False):
+            fsdp_config["offload_optimizer"] = True
+            fsdp_config["offload_master_weights"] = _ask_bool(
+                "Keep fp32 master weights in the offloaded optimizer state", True
+            )
+            fsdp_config["offload_update_chunk_mb"] = _ask(
+                "Streamed-update chunk size in MB (-1 = adaptive from free HBM)", "-1", int
+            )
+            fsdp_config["offload_update_overlap"] = _ask(
+                "In-flight chunk window (1 = serialized, 2 = double-buffer)", "1", int
+            )
+            if _ask_bool("Back the offloaded optimizer state with disk (nvme tier)", False):
+                fsdp_config["nvme_path"] = _ask("NVMe path for the optimizer tier", "/local_nvme")
     elif _ask_bool("Use ZeRO-style optimizer/parameter sharding", False):
-        zero_config = {
-            "zero_stage": _ask("ZeRO stage", "2", int, choices=["0", "1", "2", "3"]),
-            "offload_optimizer_device": _ask(
-                "Offload optimizer state to", "none", choices=["none", "cpu", "nvme"]
-            ),
-            "offload_param_device": _ask("Offload parameters to", "none", choices=["none", "cpu"]),
-        }
-        if zero_config["offload_optimizer_device"] == "nvme":
-            zero_config["nvme_path"] = _ask("NVMe path for the optimizer tier", "/local_nvme")
+        if _ask_bool("Configure from an existing DeepSpeed JSON config file", False):
+            zero_config = {"deepspeed_config_file": _ask("Path to the DeepSpeed config", "ds_config.json")}
+        else:
+            zero_config = {
+                "zero_stage": _ask("ZeRO stage", "2", int, choices=["0", "1", "2", "3"]),
+                "offload_optimizer_device": _ask(
+                    "Offload optimizer state to", "none", choices=["none", "cpu", "nvme"]
+                ),
+                "offload_param_device": _ask("Offload parameters to", "none", choices=["none", "cpu"]),
+            }
+            if zero_config["offload_optimizer_device"] == "nvme":
+                zero_config["nvme_path"] = _ask("NVMe path for the optimizer tier", "/local_nvme")
+            if zero_config["offload_optimizer_device"] != "none":
+                zero_config["offload_update_chunk_mb"] = _ask(
+                    "Streamed-update chunk size in MB (-1 = adaptive from free HBM)", "-1", int
+                )
+                zero_config["offload_update_overlap"] = _ask(
+                    "In-flight chunk window (1 = serialized, 2 = double-buffer)", "1", int
+                )
+            clip = _ask(
+                "Gradient clipping norm (empty = none)", "",
+                convert=lambda s: float(s) if s else None,
+            )
+            if clip is not None:
+                zero_config["gradient_clipping"] = clip
+            if zero_config["zero_stage"] == 3:
+                zero_config["zero3_save_16bit_model"] = _ask_bool(
+                    "Save 16-bit model weights from the fp32 masters (zero3_save_16bit_model)",
+                    False,
+                )
     if _ask_bool("Use tensor/pipeline model parallelism", False):
         mp_config = {
             "tp_degree": _ask("Tensor-parallel degree", "1", int),
             "pp_degree": _ask("Pipeline-parallel degree", "1", int),
             "sp_degree": _ask("Sequence-parallel degree (ring attention)", "1", int),
+            "ep_degree": _ask("Expert-parallel degree (MoE)", "1", int),
             "recompute_activations": _ask_bool("Recompute activations (remat)", False),
         }
+        if mp_config["pp_degree"] > 1:
+            mp_config["num_micro_batches"] = _ask(
+                "Pipeline microbatches per step (>= pp degree keeps the bubble small)", "8", int
+            )
+
+    comm_config, compilation_config = {}, {}
+    if _ask_bool("Tune gradient communication (wire dtype / compression)", False):
+        wire = _ask("Gradient carry/wire dtype", "fp32", choices=["fp32", "bf16", "fp16"])
+        if wire != "fp32":
+            comm_config["grad_reduce_dtype"] = wire
+        hook = _ask("Gradient compression hook", "none", choices=["none", "powersgd"])
+        if hook != "none":
+            comm_config["comm_hook"] = hook
+            comm_config["powersgd_rank"] = _ask("PowerSGD factor rank", "4", int)
+    if _ask_bool("Tune compilation (remat policy / layer scanning)", False):
+        policy = _ask(
+            "Rematerialization policy", "none",
+            choices=["none", "full", "dots_saveable", "nothing_saveable", "proj_saveable"],
+        )
+        if policy != "none":
+            compilation_config["remat_policy"] = policy
+        if _ask_bool("Roll transformer layers into lax.scan (compile-time win)", False):
+            compilation_config["scan_layers"] = True
 
     compute_env = ComputeEnvironment.TPU_POD.value if num_machines > 1 else ComputeEnvironment.LOCAL_MACHINE.value
     if use_cpu:
@@ -117,4 +179,6 @@ def get_cluster_input() -> ClusterConfig:
         fsdp_config=fsdp_config,
         zero_config=zero_config,
         model_parallel_config=mp_config,
+        comm_config=comm_config,
+        compilation_config=compilation_config,
     )

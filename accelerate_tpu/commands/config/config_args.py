@@ -90,6 +90,11 @@ class ClusterConfig:
     fsdp_config: Dict = field(default_factory=dict)
     zero_config: Dict = field(default_factory=dict)
     model_parallel_config: Dict = field(default_factory=dict)
+    # Gradient-wire tuning (CollectiveKwargs: grad_reduce_dtype, comm_hook,
+    # powersgd_rank) and compilation knobs (CompilationConfig: remat_policy,
+    # scan_layers).
+    comm_config: Dict = field(default_factory=dict)
+    compilation_config: Dict = field(default_factory=dict)
     # TPU pod metadata (for `accelerate-tpu tpu-config` SSH fan-out).
     tpu_name: Optional[str] = None
     tpu_zone: Optional[str] = None

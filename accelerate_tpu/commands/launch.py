@@ -224,6 +224,18 @@ def prepare_launch_env(
             env["FSDP_STATE_DICT_TYPE"] = str(fc["state_dict_type"])
         if fc.get("activation_checkpointing"):
             env["FSDP_ACTIVATION_CHECKPOINTING"] = "true"
+        if fc.get("offload_optimizer"):
+            env["FSDP_OFFLOAD_OPTIMIZER"] = "true"
+        if fc.get("offload_update_chunk_mb") is not None:
+            env["FSDP_OFFLOAD_UPDATE_CHUNK_MB"] = str(fc["offload_update_chunk_mb"])
+        if fc.get("offload_update_overlap") is not None:
+            env["FSDP_OFFLOAD_UPDATE_OVERLAP"] = str(fc["offload_update_overlap"])
+        if fc.get("nvme_path"):
+            env["FSDP_NVME_PATH"] = str(fc["nvme_path"])
+        if fc.get("offload_master_weights") is not None:
+            env["FSDP_OFFLOAD_MASTER_WEIGHTS"] = (
+                "true" if fc["offload_master_weights"] else "false"
+            )
     zc = config.zero_config
     if zc:
         if zc.get("deepspeed_config_file"):
@@ -240,6 +252,14 @@ def prepare_launch_env(
             env["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"] = str(zc["offload_param_device"])
         if zc.get("nvme_path"):
             env["ACCELERATE_DEEPSPEED_NVME_PATH"] = str(zc["nvme_path"])
+        if zc.get("gradient_clipping") is not None:
+            env["ACCELERATE_DEEPSPEED_GRADIENT_CLIPPING"] = str(zc["gradient_clipping"])
+        if zc.get("zero3_save_16bit_model"):
+            env["ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL"] = "true"
+        if zc.get("offload_update_chunk_mb") is not None:
+            env["ACCELERATE_DEEPSPEED_OFFLOAD_UPDATE_CHUNK_MB"] = str(zc["offload_update_chunk_mb"])
+        if zc.get("offload_update_overlap") is not None:
+            env["ACCELERATE_DEEPSPEED_OFFLOAD_UPDATE_OVERLAP"] = str(zc["offload_update_overlap"])
     mc = config.model_parallel_config
     if mc:
         env["ACCELERATE_USE_MEGATRON_LM"] = "true"
@@ -249,8 +269,24 @@ def prepare_launch_env(
             env["MEGATRON_LM_PP_DEGREE"] = str(mc["pp_degree"])
         if mc.get("sp_degree") is not None:
             env["MEGATRON_LM_SP_DEGREE"] = str(mc["sp_degree"])
+        if mc.get("ep_degree") is not None:
+            env["MEGATRON_LM_EP_DEGREE"] = str(mc["ep_degree"])
+        if mc.get("num_micro_batches") is not None:
+            env["MEGATRON_LM_NUM_MICRO_BATCHES"] = str(mc["num_micro_batches"])
         if mc.get("recompute_activations"):
             env["MEGATRON_LM_RECOMPUTE_ACTIVATIONS"] = "true"
+    cc = config.comm_config or {}
+    if cc.get("grad_reduce_dtype"):
+        env["ACCELERATE_GRAD_REDUCE_DTYPE"] = str(cc["grad_reduce_dtype"])
+    if cc.get("comm_hook") and cc["comm_hook"] != "none":
+        env["ACCELERATE_COMM_HOOK"] = str(cc["comm_hook"])
+    if cc.get("powersgd_rank") is not None:
+        env["ACCELERATE_POWERSGD_RANK"] = str(cc["powersgd_rank"])
+    comp = config.compilation_config or {}
+    if comp.get("remat_policy") and comp["remat_policy"] != "none":
+        env["ACCELERATE_REMAT_POLICY"] = str(comp["remat_policy"])
+    if comp.get("scan_layers"):
+        env["ACCELERATE_SCAN_LAYERS"] = "true"
     return env
 
 

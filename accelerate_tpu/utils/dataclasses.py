@@ -201,6 +201,21 @@ class CollectiveKwargs(KwargsHandler):
     powersgd_rank: int = 4                   # factor rank r; wire cost r*(m+n) vs m*n
     comm_hook_min_size: int = 4096           # leaves below this reduce uncompressed
 
+    @classmethod
+    def from_env(cls) -> "CollectiveKwargs":
+        """Launcher-env hydration (the questionnaire's comm_config block).
+        A factory, NOT ``__post_init__``: an explicitly constructed handler
+        passed to ``Accelerator(kwargs_handlers=[...])`` must win over the
+        config file — env applies only to the accelerator's fallback."""
+        kw = {}
+        if os.environ.get("ACCELERATE_GRAD_REDUCE_DTYPE"):
+            kw["grad_reduce_dtype"] = os.environ["ACCELERATE_GRAD_REDUCE_DTYPE"]
+        if os.environ.get("ACCELERATE_COMM_HOOK"):
+            kw["comm_hook"] = os.environ["ACCELERATE_COMM_HOOK"]
+        if os.environ.get("ACCELERATE_POWERSGD_RANK"):
+            kw["powersgd_rank"] = int(os.environ["ACCELERATE_POWERSGD_RANK"])
+        return cls(**kw)
+
 
 @dataclass
 class GradScalerKwargs(KwargsHandler):
@@ -304,6 +319,18 @@ class CompilationConfig(KwargsHandler):
     fullgraph: bool = True   # parity no-op: XLA always traces a full graph
     dynamic: bool = False    # parity no-op: static shapes on TPU
 
+    @classmethod
+    def from_env(cls) -> "CompilationConfig":
+        """Launcher-env hydration (questionnaire remat_policy/scan answers).
+        A factory so an explicitly passed ``compilation_config`` wins over the
+        config file; env applies only to the accelerator's default."""
+        kw = {}
+        if os.environ.get("ACCELERATE_REMAT_POLICY"):
+            kw["remat_policy"] = os.environ["ACCELERATE_REMAT_POLICY"]
+        if os.environ.get("ACCELERATE_SCAN_LAYERS"):
+            kw["scan_layers"] = parse_flag_from_env("ACCELERATE_SCAN_LAYERS")
+        return cls(**kw)
+
 
 @dataclass
 class MeshConfig:
@@ -392,6 +419,16 @@ class FullyShardedDataParallelPlugin:
             self.state_dict_type = StateDictType(os.environ["FSDP_STATE_DICT_TYPE"])
         if os.environ.get("FSDP_ACTIVATION_CHECKPOINTING"):
             self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
+        if os.environ.get("FSDP_OFFLOAD_OPTIMIZER"):
+            self.offload_optimizer = parse_flag_from_env("FSDP_OFFLOAD_OPTIMIZER")
+        if os.environ.get("FSDP_OFFLOAD_UPDATE_CHUNK_MB"):
+            self.offload_update_chunk_mb = int(os.environ["FSDP_OFFLOAD_UPDATE_CHUNK_MB"])
+        if os.environ.get("FSDP_OFFLOAD_UPDATE_OVERLAP"):
+            self.offload_update_overlap = int(os.environ["FSDP_OFFLOAD_UPDATE_OVERLAP"])
+        if os.environ.get("FSDP_NVME_PATH"):
+            self.offload_optimizer_nvme_path = os.environ["FSDP_NVME_PATH"]
+        if os.environ.get("FSDP_OFFLOAD_MASTER_WEIGHTS"):
+            self.offload_master_weights = parse_flag_from_env("FSDP_OFFLOAD_MASTER_WEIGHTS")
 
     @property
     def shards_params(self) -> bool:
@@ -469,6 +506,20 @@ class ZeroPlugin:
             self.offload_param_device = os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_PARAM_DEVICE"]
         if os.environ.get("ACCELERATE_DEEPSPEED_NVME_PATH"):
             self.nvme_path = os.environ["ACCELERATE_DEEPSPEED_NVME_PATH"]
+        if os.environ.get("ACCELERATE_DEEPSPEED_GRADIENT_CLIPPING"):
+            self.gradient_clipping = float(os.environ["ACCELERATE_DEEPSPEED_GRADIENT_CLIPPING"])
+        if os.environ.get("ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL"):
+            self.zero3_save_16bit_model = parse_flag_from_env(
+                "ACCELERATE_DEEPSPEED_ZERO3_SAVE_16BIT_MODEL"
+            )
+        if os.environ.get("ACCELERATE_DEEPSPEED_OFFLOAD_UPDATE_CHUNK_MB"):
+            self.offload_update_chunk_mb = int(
+                os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_UPDATE_CHUNK_MB"]
+            )
+        if os.environ.get("ACCELERATE_DEEPSPEED_OFFLOAD_UPDATE_OVERLAP"):
+            self.offload_update_overlap = int(
+                os.environ["ACCELERATE_DEEPSPEED_OFFLOAD_UPDATE_OVERLAP"]
+            )
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(f"ZeRO stage must be 0-3, got {self.zero_stage}")
         if self.offload_optimizer_device not in ("none", "cpu", "nvme"):
@@ -683,6 +734,10 @@ class ModelParallelPlugin:
             self.pp_degree = int(os.environ["MEGATRON_LM_PP_DEGREE"])
         if os.environ.get("MEGATRON_LM_SP_DEGREE"):
             self.sp_degree = int(os.environ["MEGATRON_LM_SP_DEGREE"])
+        if os.environ.get("MEGATRON_LM_EP_DEGREE"):
+            self.expert_parallel_degree = int(os.environ["MEGATRON_LM_EP_DEGREE"])
+        if os.environ.get("MEGATRON_LM_NUM_MICRO_BATCHES"):
+            self.num_micro_batches = int(os.environ["MEGATRON_LM_NUM_MICRO_BATCHES"])
         if os.environ.get("MEGATRON_LM_RECOMPUTE_ACTIVATIONS"):
             self.recompute_activations = parse_flag_from_env("MEGATRON_LM_RECOMPUTE_ACTIVATIONS")
 
