@@ -49,6 +49,19 @@ def _ask_bool(prompt: str, default: bool = False) -> bool:
     return raw == "yes"
 
 
+def _ask_streamed_update() -> dict:
+    """The chunked host-offload tuning pair — shared verbatim between the
+    FSDP and ZeRO flows so the prompts/defaults cannot diverge."""
+    return {
+        "offload_update_chunk_mb": _ask(
+            "Streamed-update chunk size in MB (-1 = adaptive from free HBM)", "-1", int
+        ),
+        "offload_update_overlap": _ask(
+            "In-flight chunk window (1 = serialized, 2 = double-buffer)", "1", int
+        ),
+    }
+
+
 def get_cluster_input() -> ClusterConfig:
     num_machines = _ask("How many machines (hosts) will you use", "1", int)
     machine_rank, ip, port = 0, None, None
@@ -87,12 +100,7 @@ def get_cluster_input() -> ClusterConfig:
             fsdp_config["offload_master_weights"] = _ask_bool(
                 "Keep fp32 master weights in the offloaded optimizer state", True
             )
-            fsdp_config["offload_update_chunk_mb"] = _ask(
-                "Streamed-update chunk size in MB (-1 = adaptive from free HBM)", "-1", int
-            )
-            fsdp_config["offload_update_overlap"] = _ask(
-                "In-flight chunk window (1 = serialized, 2 = double-buffer)", "1", int
-            )
+            fsdp_config.update(_ask_streamed_update())
             if _ask_bool("Back the offloaded optimizer state with disk (nvme tier)", False):
                 fsdp_config["nvme_path"] = _ask("NVMe path for the optimizer tier", "/local_nvme")
     elif _ask_bool("Use ZeRO-style optimizer/parameter sharding", False):
@@ -109,12 +117,7 @@ def get_cluster_input() -> ClusterConfig:
             if zero_config["offload_optimizer_device"] == "nvme":
                 zero_config["nvme_path"] = _ask("NVMe path for the optimizer tier", "/local_nvme")
             if zero_config["offload_optimizer_device"] != "none":
-                zero_config["offload_update_chunk_mb"] = _ask(
-                    "Streamed-update chunk size in MB (-1 = adaptive from free HBM)", "-1", int
-                )
-                zero_config["offload_update_overlap"] = _ask(
-                    "In-flight chunk window (1 = serialized, 2 = double-buffer)", "1", int
-                )
+                zero_config.update(_ask_streamed_update())
             clip = _ask(
                 "Gradient clipping norm (empty = none)", "",
                 convert=lambda s: float(s) if s else None,

@@ -56,6 +56,12 @@ def _answer_script(monkeypatch, answers):
             return ""  # accept defaults for anything beyond the script
 
     monkeypatch.setattr(builtins, "input", fake_input)
+    # pin the input() fallback path: under `pytest -s` on a real terminal the
+    # choices questions would take the arrow-key menu branch (raw keypress
+    # reads) and ignore the scripted answers entirely
+    import sys as _sys
+
+    monkeypatch.setattr(_sys.stdin, "isatty", lambda: False, raising=False)
 
 
 def _roundtrip(config: ClusterConfig, tmp_path) -> ClusterConfig:
