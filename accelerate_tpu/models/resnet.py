@@ -1,0 +1,180 @@
+"""ResNet (v1.5 bottleneck) in flax — the CV model family for the BASELINE
+``examples/cv_example.py`` row (reference trains a timm ResNet-50 on pets,
+``/root/reference/examples/cv_example.py:1-210``).
+
+TPU-first choices:
+
+* **NHWC layout** — what XLA's TPU conv emitter expects; convs lower onto the
+  MXU as implicit GEMMs.
+* **GroupNorm, not BatchNorm** — batch statistics are mutable state that
+  breaks the purely functional compiled train step AND need a cross-replica
+  ``psum`` per layer under data parallelism (sync-BN).  GroupNorm is
+  batch-independent: same params-only tree as every other model here, no
+  hidden collectives, identical FLOPs.  (The standard JAX ResNet recipe for
+  exactly this reason.)
+* **Static shapes** — fixed input resolution per compile; bf16 compute /
+  fp32 params via the usual policy.
+
+``resnet50()`` is the benchmark geometry; depths follow the torchvision
+family table.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "resnet18", "resnet50", "resnet101", "resnet_flops_per_image"]
+
+
+class _GNorm(nn.Module):
+    """GroupNorm with the group count derived from the channel dim at call
+    time (32 at standard widths; gcd keeps narrow widths valid)."""
+
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        groups = math.gcd(32, x.shape[-1])
+        return nn.GroupNorm(
+            num_groups=groups, dtype=self.dtype, param_dtype=self.param_dtype, name="gn"
+        )(x)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 (stride here: the v1.5 variant) -> 1x1 expand x4."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype)
+        # 32 groups at standard widths; gcd keeps narrow test widths valid
+        def norm(name):
+            return _GNorm(self.dtype, self.param_dtype, name=name)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(norm("norm1")(y))
+        y = conv(self.features, (3, 3), strides=self.strides, name="conv2")(y)
+        y = nn.relu(norm("norm2")(y))
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = norm("norm3")(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), strides=self.strides, name="downsample"
+            )(residual)
+            residual = norm("downsample_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 (ResNet-18/34)."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype)
+        # 32 groups at standard widths; gcd keeps narrow test widths valid
+        def norm(name):
+            return _GNorm(self.dtype, self.param_dtype, name=name)
+        residual = x
+        y = conv(self.features, (3, 3), strides=self.strides, name="conv1")(x)
+        y = nn.relu(norm("norm1")(y))
+        y = conv(self.features, (3, 3), name="conv2")(y)
+        y = norm("norm2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1), strides=self.strides, name="downsample")(residual)
+            residual = norm("downsample_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """``__call__(images [B,H,W,3]) -> logits [B,num_classes]`` (NHWC)."""
+
+    stage_sizes: Sequence[int]
+    block: Any = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)], use_bias=False,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="stem_conv",
+        )(x)
+        x = nn.relu(_GNorm(self.dtype, self.param_dtype, name="stem_norm")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(
+                    self.width * 2 ** i, strides=strides,
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name=f"stage{i + 1}_block{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="classifier"
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block=BottleneckBlock, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 23, 3], block=BottleneckBlock, **kw)
+
+
+def resnet_flops_per_image(model: ResNet, image_size: int = 224) -> float:
+    """Analytic forward FLOPs per image (2*K*K*Cin*Cout*Hout*Wout per conv +
+    the classifier GEMM) — the honest MFU numerator for the CV bench.
+    Norms/adds/pools are bandwidth, not MXU FLOPs, and are excluded like in
+    the LM bench's 6*N*S accounting."""
+    flops = 0.0
+    h = w = image_size // 2  # stem conv output
+    flops += 2 * 7 * 7 * 3 * model.width * h * w
+    h = w = h // 2  # maxpool
+    cin = model.width
+    for i, num_blocks in enumerate(model.stage_sizes):
+        feats = model.width * 2 ** i
+        for j in range(num_blocks):
+            stride = 2 if i > 0 and j == 0 else 1
+            ho = h // stride
+            wo = w // stride
+            if model.block is BottleneckBlock:
+                flops += 2 * 1 * 1 * cin * feats * h * w          # conv1 (pre-stride res)
+                flops += 2 * 3 * 3 * feats * feats * ho * wo       # conv2 (strided)
+                flops += 2 * 1 * 1 * feats * feats * 4 * ho * wo   # conv3
+                if cin != feats * 4 or stride != 1:
+                    flops += 2 * 1 * 1 * cin * feats * 4 * ho * wo
+                cin = feats * 4
+            else:
+                flops += 2 * 3 * 3 * cin * feats * ho * wo
+                flops += 2 * 3 * 3 * feats * feats * ho * wo
+                if cin != feats or stride != 1:
+                    flops += 2 * 1 * 1 * cin * feats * ho * wo
+                cin = feats
+            h, w = ho, wo
+    flops += 2 * cin * model.num_classes
+    return flops
