@@ -838,8 +838,12 @@ class StreamingTransformer(StreamingExecutor):
             if getattr(cfg, "positional", "rope") == "learned":
                 embed_params, pos_params = stage_params
                 x = embed.apply({"params": embed_params}, ids)
-                pos = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-                return x + pos.apply({"params": pos_params}, positions), positions
+                offset = getattr(cfg, "pos_offset", 0)
+                pos = nn.Embed(
+                    cfg.max_seq_len + offset, cfg.hidden_size,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                )
+                return x + pos.apply({"params": pos_params}, positions + offset), positions
             return embed.apply({"params": stage_params}, ids), positions
 
         def head_fn(stage_params, x, positions):
@@ -853,7 +857,10 @@ class StreamingTransformer(StreamingExecutor):
                 embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
                 logits = embed.apply({"params": head_params}, x.astype(cfg.param_dtype), method="attend")
                 return logits.astype(jnp.float32)
-            return (x @ head_params["kernel"].astype(cfg.dtype)).astype(jnp.float32)
+            logits = x @ head_params["kernel"].astype(cfg.dtype)
+            if getattr(cfg, "lm_head_bias", False):
+                logits = logits + head_params["bias"].astype(cfg.dtype)
+            return logits.astype(jnp.float32)
 
         head_source = "embed_tokens" if cfg.tie_word_embeddings else "lm_head"
         chunks = [

@@ -44,7 +44,7 @@ __all__ = [
 ]
 
 # architectures with a key mapping; config.json "model_type" values
-SUPPORTED_MODEL_TYPES = ("gpt2", "llama")
+SUPPORTED_MODEL_TYPES = ("gpt2", "llama", "opt", "gptj", "gpt_neox")
 
 
 def _read_hf_config(checkpoint: str) -> Dict[str, Any]:
@@ -95,6 +95,92 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
                 f"GPT-2 activation {hf['activation_function']!r} is not mapped "
                 "(gelu_new is the family standard)"
             )
+    elif model_type == "opt":
+        # OPT (the BASELINE big-model-inference flagship, OPT-30B): pre-LN
+        # decoder, learned positions with the family's +2 row offset, ReLU
+        # MLP, biases everywhere, tied embeddings.
+        if not hf.get("do_layer_norm_before", True):
+            raise NotImplementedError(
+                "OPT with do_layer_norm_before=false (the 350m post-LN variant) "
+                "is not mapped; every other OPT size is pre-LN and supported."
+            )
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise NotImplementedError(
+                "OPT word_embed_proj_dim != hidden_size (the 350m factorized "
+                "embedding) is not mapped."
+            )
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["ffn_dim"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            norm_type="layernorm",
+            use_bias=True,
+            positional="learned",
+            pos_offset=2,
+            mlp_variant="relu",
+        )
+        if hf.get("activation_function", "relu") != "relu":
+            raise NotImplementedError(
+                f"OPT activation {hf['activation_function']!r} is not mapped"
+            )
+    elif model_type == "gptj":
+        # GPT-J-6B (the BASELINE lead row): parallel residual with a SHARED
+        # pre-norm, interleaved partial rotary, biasless attention but biased
+        # MLP, untied lm_head WITH bias.
+        n_embd = hf["n_embd"]
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=n_embd,
+            intermediate_size=hf.get("n_inner") or 4 * n_embd,
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            num_kv_heads=hf["n_head"],
+            max_seq_len=hf.get("n_positions", 2048),
+            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            norm_type="layernorm",
+            positional="rope",
+            rope_dim=hf.get("rotary_dim") or n_embd // hf["n_head"],
+            rope_interleaved=True,
+            parallel_residual=True,
+            shared_norm=True,
+            attn_bias=False,
+            mlp_bias=True,
+            lm_head_bias=True,
+            mlp_variant="gelu",
+        )
+    elif model_type == "gpt_neox":
+        # GPT-NeoX-20B: parallel residual with two norms, rotate-half partial
+        # rotary (rotary_pct), biases everywhere, untied biasless embed_out.
+        head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+        act = hf.get("hidden_act", "gelu")
+        if act not in ("gelu", "gelu_new", "gelu_fast", "gelu_pytorch_tanh"):
+            raise NotImplementedError(f"gpt_neox hidden_act {act!r} is not mapped")
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            # current transformers writes "rope_theta"; older NeoX configs
+            # used the deprecated "rotary_emb_base" spelling
+            rope_theta=hf.get("rope_theta", hf.get("rotary_emb_base", 10000.0)),
+            rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            norm_type="layernorm",
+            positional="rope",
+            rope_dim=int(hf.get("rotary_pct", 0.25) * head_dim),
+            parallel_residual=hf.get("use_parallel_residual", True),
+            use_bias=True,
+            mlp_variant="gelu_exact" if act == "gelu" else "gelu",
+        )
     elif model_type == "llama":
         fields = dict(
             vocab_size=hf["vocab_size"],
@@ -199,6 +285,114 @@ def _gpt2_qkv_entries(cfg: TransformerConfig, i: int) -> Dict[str, Tuple[str, Ca
     return out
 
 
+def opt_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """OPT naming (``model.decoder.layers.{i}...``) → native tree.  Linear
+    layout throughout ([out, in] → transpose); separate q/k/v; biases on
+    every projection and norm; tied lm_head skipped (embed.attend serves it)."""
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("model.decoder.embed_tokens.weight", _ident),
+        "pos_embed.embedding": ("model.decoder.embed_positions.weight", _ident),
+        "final_norm.scale": ("model.decoder.final_layer_norm.weight", _ident),
+        "final_norm.bias": ("model.decoder.final_layer_norm.bias", _ident),
+    }
+    proj_pairs = [
+        ("attn.q_proj", "self_attn.q_proj"),
+        ("attn.k_proj", "self_attn.k_proj"),
+        ("attn.v_proj", "self_attn.v_proj"),
+        ("attn.o_proj", "self_attn.out_proj"),
+        ("mlp.up_proj", "fc1"),
+        ("mlp.down_proj", "fc2"),
+    ]
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"model.decoder.layers.{i}"
+        m.update({
+            f"{n}.input_norm.scale": (f"{h}.self_attn_layer_norm.weight", _ident),
+            f"{n}.input_norm.bias": (f"{h}.self_attn_layer_norm.bias", _ident),
+            f"{n}.post_attn_norm.scale": (f"{h}.final_layer_norm.weight", _ident),
+            f"{n}.post_attn_norm.bias": (f"{h}.final_layer_norm.bias", _ident),
+        })
+        for ours, theirs in proj_pairs:
+            m[f"{n}.{ours}.kernel"] = (f"{h}.{theirs}.weight", _t)
+            m[f"{n}.{ours}.bias"] = (f"{h}.{theirs}.bias", _ident)
+    return m
+
+
+def gptj_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """GPT-J naming (``transformer.h.{i}...``): Linear layout (transpose),
+    separate biasless q/k/v, biased fc_in/fc_out, shared ln_1, biased
+    untied lm_head."""
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("transformer.wte.weight", _ident),
+        "final_norm.scale": ("transformer.ln_f.weight", _ident),
+        "final_norm.bias": ("transformer.ln_f.bias", _ident),
+        "lm_head.kernel": ("lm_head.weight", _t),
+        "lm_head.bias": ("lm_head.bias", _ident),
+    }
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"transformer.h.{i}"
+        m.update({
+            f"{n}.input_norm.scale": (f"{h}.ln_1.weight", _ident),
+            f"{n}.input_norm.bias": (f"{h}.ln_1.bias", _ident),
+            f"{n}.attn.q_proj.kernel": (f"{h}.attn.q_proj.weight", _t),
+            f"{n}.attn.k_proj.kernel": (f"{h}.attn.k_proj.weight", _t),
+            f"{n}.attn.v_proj.kernel": (f"{h}.attn.v_proj.weight", _t),
+            f"{n}.attn.o_proj.kernel": (f"{h}.attn.out_proj.weight", _t),
+            f"{n}.mlp.up_proj.kernel": (f"{h}.mlp.fc_in.weight", _t),
+            f"{n}.mlp.up_proj.bias": (f"{h}.mlp.fc_in.bias", _ident),
+            f"{n}.mlp.down_proj.kernel": (f"{h}.mlp.fc_out.weight", _t),
+            f"{n}.mlp.down_proj.bias": (f"{h}.mlp.fc_out.bias", _ident),
+        })
+    return m
+
+
+def _neox_qkv_split(cfg: TransformerConfig, which: int) -> Callable:
+    """NeoX fuses qkv head-major: row block ``h*3D..(h+1)*3D`` holds head
+    ``h``'s q, k, v stacked.  Unstack one of the three."""
+    heads, d = cfg.num_heads, cfg.resolved_head_dim
+
+    def f(x: np.ndarray) -> np.ndarray:
+        if x.ndim == 2:  # weight [3h, h_in]
+            picked = x.reshape(heads, 3, d, x.shape[1])[:, which]
+            return np.ascontiguousarray(picked.reshape(heads * d, x.shape[1]).T)
+        picked = x.reshape(heads, 3, d)[:, which]  # bias [3h]
+        return np.ascontiguousarray(picked.reshape(heads * d))
+
+    return f
+
+
+def gpt_neox_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """GPT-NeoX naming (``gpt_neox.layers.{i}...``): fused head-major qkv,
+    biases throughout, two norms per layer, untied biasless embed_out."""
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("gpt_neox.embed_in.weight", _ident),
+        "final_norm.scale": ("gpt_neox.final_layer_norm.weight", _ident),
+        "final_norm.bias": ("gpt_neox.final_layer_norm.bias", _ident),
+        "lm_head.kernel": ("embed_out.weight", _t),
+    }
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"gpt_neox.layers.{i}"
+        m.update({
+            f"{n}.input_norm.scale": (f"{h}.input_layernorm.weight", _ident),
+            f"{n}.input_norm.bias": (f"{h}.input_layernorm.bias", _ident),
+            f"{n}.post_attn_norm.scale": (f"{h}.post_attention_layernorm.weight", _ident),
+            f"{n}.post_attn_norm.bias": (f"{h}.post_attention_layernorm.bias", _ident),
+            f"{n}.attn.o_proj.kernel": (f"{h}.attention.dense.weight", _t),
+            f"{n}.attn.o_proj.bias": (f"{h}.attention.dense.bias", _ident),
+            f"{n}.mlp.up_proj.kernel": (f"{h}.mlp.dense_h_to_4h.weight", _t),
+            f"{n}.mlp.up_proj.bias": (f"{h}.mlp.dense_h_to_4h.bias", _ident),
+            f"{n}.mlp.down_proj.kernel": (f"{h}.mlp.dense_4h_to_h.weight", _t),
+            f"{n}.mlp.down_proj.bias": (f"{h}.mlp.dense_4h_to_h.bias", _ident),
+        })
+        for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            m[f"{n}.attn.{proj}.kernel"] = (
+                f"{h}.attention.query_key_value.weight", _neox_qkv_split(cfg, j)
+            )
+            m[f"{n}.attn.{proj}.bias"] = (
+                f"{h}.attention.query_key_value.bias", _neox_qkv_split(cfg, j)
+            )
+    return m
+
+
 def llama_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     """HF Llama naming (``model.layers.{i}.self_attn...``) → native tree.
     HF Llama uses the rotate-half rope convention, which ``_rope`` implements
@@ -234,6 +428,12 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = gpt2_key_map(cfg)
         for i in range(cfg.num_layers):
             mapping.update(_gpt2_qkv_entries(cfg, i))
+    elif hf["model_type"] == "opt":
+        mapping = opt_key_map(cfg)
+    elif hf["model_type"] == "gptj":
+        mapping = gptj_key_map(cfg)
+    elif hf["model_type"] == "gpt_neox":
+        mapping = gpt_neox_key_map(cfg)
     else:
         mapping = llama_key_map(cfg)
     return cfg, mapping

@@ -57,21 +57,19 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype)
-        # 32 groups at standard widths; gcd keeps narrow test widths valid
-        def norm(name):
-            return _GNorm(self.dtype, self.param_dtype, name=name)
+        norm = partial(_GNorm, self.dtype, self.param_dtype)
         residual = x
         y = conv(self.features, (1, 1), name="conv1")(x)
-        y = nn.relu(norm("norm1")(y))
+        y = nn.relu(norm(name="norm1")(y))
         y = conv(self.features, (3, 3), strides=self.strides, name="conv2")(y)
-        y = nn.relu(norm("norm2")(y))
+        y = nn.relu(norm(name="norm2")(y))
         y = conv(self.features * 4, (1, 1), name="conv3")(y)
-        y = norm("norm3")(y)
+        y = norm(name="norm3")(y)
         if residual.shape != y.shape:
             residual = conv(
                 self.features * 4, (1, 1), strides=self.strides, name="downsample"
             )(residual)
-            residual = norm("downsample_norm")(residual)
+            residual = norm(name="downsample_norm")(residual)
         return nn.relu(y + residual)
 
 
@@ -86,17 +84,15 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype)
-        # 32 groups at standard widths; gcd keeps narrow test widths valid
-        def norm(name):
-            return _GNorm(self.dtype, self.param_dtype, name=name)
+        norm = partial(_GNorm, self.dtype, self.param_dtype)
         residual = x
         y = conv(self.features, (3, 3), strides=self.strides, name="conv1")(x)
-        y = nn.relu(norm("norm1")(y))
+        y = nn.relu(norm(name="norm1")(y))
         y = conv(self.features, (3, 3), name="conv2")(y)
-        y = norm("norm2")(y)
+        y = norm(name="norm2")(y)
         if residual.shape != y.shape:
             residual = conv(self.features, (1, 1), strides=self.strides, name="downsample")(residual)
-            residual = norm("downsample_norm")(residual)
+            residual = norm(name="downsample_norm")(residual)
         return nn.relu(y + residual)
 
 

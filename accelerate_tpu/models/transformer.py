@@ -107,7 +107,29 @@ class TransformerConfig:
     norm_type: str = "rmsnorm"         # "rmsnorm" | "layernorm" (centered, with bias)
     use_bias: bool = False             # biases on attention/MLP projections
     positional: str = "rope"           # "rope" | "learned" (wpe-style table)
-    mlp_variant: str = "swiglu"        # "swiglu" | "gelu" (fc -> gelu_new -> proj)
+    # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact" the erf
+    # form (GPT-NeoX); "relu" the OPT family
+    mlp_variant: str = "swiglu"        # "swiglu" | "gelu" | "gelu_exact" | "relu"
+    # Learned-position table offset: OPT reserves the first 2 rows (padding
+    # convention), so position i reads row i+2 and the table has
+    # max_seq_len + pos_offset rows.
+    pos_offset: int = 0
+    # Parallel-residual block (GPT-J / GPT-NeoX): x + attn(norm(x)) +
+    # mlp(norm'(x)) computed from the SAME input instead of sequentially.
+    # shared_norm=True (GPT-J) reuses one norm for both branches.
+    parallel_residual: bool = False
+    shared_norm: bool = False
+    # Partial rotary: rope applied to the first rope_dim dims of each head
+    # (GPT-J rotary_dim, NeoX rotary_pct), the rest pass through.  None =
+    # full head_dim.  rope_interleaved selects GPT-J's rotate-every-two
+    # pairing over the default rotate-half convention.
+    rope_dim: Optional[int] = None
+    rope_interleaved: bool = False
+    # Per-site bias overrides (GPT-J: biasless attention but biased MLP);
+    # None falls back to use_bias.  lm_head_bias covers GPT-J's biased head.
+    attn_bias: Optional[bool] = None
+    mlp_bias: Optional[bool] = None
+    lm_head_bias: bool = False
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False                # jax.checkpoint each layer
@@ -169,9 +191,10 @@ class TransformerConfig:
             raise ValueError(
                 f"Unknown positional {self.positional!r}; choose 'rope' or 'learned'"
             )
-        if self.mlp_variant not in ("swiglu", "gelu"):
+        if self.mlp_variant not in ("swiglu", "gelu", "gelu_exact", "relu"):
             raise ValueError(
-                f"Unknown mlp_variant {self.mlp_variant!r}; choose 'swiglu' or 'gelu'"
+                f"Unknown mlp_variant {self.mlp_variant!r}; choose 'swiglu', "
+                "'gelu', 'gelu_exact' or 'relu'"
             )
 
     @classmethod
@@ -274,7 +297,8 @@ def cached_attention(q, k, v, q_positions):
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding over the last dim of [B, S, H, D]."""
+    """Rotary embedding over the last dim of [B, S, H, D] — rotate-half
+    convention (Llama/NeoX)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
@@ -283,6 +307,35 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def _rope_interleaved(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """GPT-J's rotate-every-two pairing: dims (0,1), (2,3), ... form the
+    rotation pairs (vs rotate-half's (i, i+D/2))."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x_even = xf[..., 0::2]
+    x_odd = xf[..., 1::2]
+    out_even = x_even * cos - x_odd * sin
+    out_odd = x_odd * cos + x_even * sin
+    # re-interleave: [e0, o0, e1, o1, ...]
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(xf.shape)
+    return out.astype(x.dtype)
+
+
+def _apply_rope(x: jax.Array, positions: jax.Array, cfg: "TransformerConfig") -> jax.Array:
+    """Config-selected rope: full or partial (first ``rope_dim`` dims),
+    rotate-half or interleaved."""
+    fn = _rope_interleaved if cfg.rope_interleaved else _rope
+    rd = cfg.rope_dim
+    if rd is None or rd >= x.shape[-1]:
+        return fn(x, positions, cfg.rope_theta)
+    rotated = fn(x[..., :rd], positions, cfg.rope_theta)
+    return jnp.concatenate([rotated, x[..., rd:]], axis=-1)
 
 
 class RMSNorm(nn.Module):
@@ -334,7 +387,7 @@ class Attention(nn.Module):
         new_v_cache))``."""
         cfg = self.config
         hd = cfg.resolved_head_dim
-        dense = functools_partial_dense(cfg)
+        dense = functools_partial_dense(cfg, use_bias=cfg.attn_bias)
         q = _tag_proj(dense("q_proj", cfg.num_heads * hd)(x))
         k = _tag_proj(dense("k_proj", cfg.num_kv_heads * hd)(x))
         v = _tag_proj(dense("v_proj", cfg.num_kv_heads * hd)(x))
@@ -343,8 +396,8 @@ class Attention(nn.Module):
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
         v = v.reshape(b, s, cfg.num_kv_heads, hd)
         if cfg.positional == "rope":
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
+            q = _apply_rope(q, positions, cfg)
+            k = _apply_rope(k, positions, cfg)
         if cache is not None:
             k_cache, v_cache, index = cache
             k_cache = jax.lax.dynamic_update_slice(
@@ -364,7 +417,8 @@ class Attention(nn.Module):
         return _tag_proj(dense("o_proj", cfg.hidden_size)(out))
 
 
-def functools_partial_dense(cfg: TransformerConfig):
+def functools_partial_dense(cfg: TransformerConfig, use_bias: Optional[bool] = None):
+    use_bias = cfg.use_bias if use_bias is None else use_bias
     if cfg.quantization is not None:
         if cfg.use_fp8:
             raise ValueError(
@@ -380,7 +434,7 @@ def functools_partial_dense(cfg: TransformerConfig):
                 bits=cfg.quantization,
                 block_size=cfg.quantization_block_size,
                 dtype=cfg.dtype,
-                use_bias=cfg.use_bias,
+                use_bias=use_bias,
                 name=name,
             )
 
@@ -398,7 +452,7 @@ def functools_partial_dense(cfg: TransformerConfig):
     def make(name: str, features: int):
         return nn.Dense(
             features,
-            use_bias=cfg.use_bias,
+            use_bias=use_bias,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02),
@@ -415,12 +469,17 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = functools_partial_dense(cfg)
-        if cfg.mlp_variant == "gelu":
-            # GPT-2 family: fc -> gelu_new (tanh approximation, which flax's
-            # approximate gelu reproduces) -> proj
+        dense = functools_partial_dense(cfg, use_bias=cfg.mlp_bias)
+        if cfg.mlp_variant in ("gelu", "gelu_exact", "relu"):
+            # GPT-2/GPT-J: gelu_new (tanh approximation, = flax approximate
+            # gelu); NeoX: exact erf gelu; OPT: relu
+            act = {
+                "relu": nn.relu,
+                "gelu": lambda z: nn.gelu(z, approximate=True),
+                "gelu_exact": lambda z: nn.gelu(z, approximate=False),
+            }[cfg.mlp_variant]
             up = _tag_proj(dense("up_proj", cfg.intermediate_size)(x), "proj_wide")
-            return _tag_proj(dense("down_proj", cfg.hidden_size)(nn.gelu(up, approximate=True)))
+            return _tag_proj(dense("down_proj", cfg.hidden_size)(act(up)))
         gate = _tag_proj(dense("gate_proj", cfg.intermediate_size)(x))
         up = _tag_proj(dense("up_proj", cfg.intermediate_size)(x), "proj_wide")
         return _tag_proj(dense("down_proj", cfg.hidden_size)(nn.silu(gate) * up))
@@ -432,21 +491,25 @@ class DecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache=None):
         cfg = self.config
-        attn_out = Attention(cfg, name="attn")(
-            make_norm(cfg, "input_norm")(x), positions,
-            cache=cache,
-        )
+        normed = make_norm(cfg, "input_norm")(x)
+        attn_out = Attention(cfg, name="attn")(normed, positions, cache=cache)
         new_kv = None
         if cache is not None:
             attn_out, new_kv = attn_out
-        x = x + attn_out
         if cfg.num_experts > 0:
             from ..parallel.moe import MoEMLP
 
             mlp = MoEMLP(cfg, name="moe_mlp")
         else:
             mlp = MLP(cfg, name="mlp")
-        x = x + mlp(make_norm(cfg, "post_attn_norm")(x))
+        if cfg.parallel_residual:
+            # GPT-J / GPT-NeoX block: both branches read the SAME input;
+            # GPT-J (shared_norm) reuses the attention branch's norm
+            mlp_in = normed if cfg.shared_norm else make_norm(cfg, "post_attn_norm")(x)
+            x = x + attn_out + mlp(mlp_in)
+        else:
+            x = x + attn_out
+            x = x + mlp(make_norm(cfg, "post_attn_norm")(x))
         return x if cache is None else (x, new_kv)
 
 
@@ -481,14 +544,14 @@ class Transformer(nn.Module):
         x = embed(input_ids)
         if cfg.positional == "learned":
             pos_embed = nn.Embed(
-                cfg.max_seq_len,
+                cfg.max_seq_len + cfg.pos_offset,
                 cfg.hidden_size,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 embedding_init=nn.initializers.normal(0.02),
                 name="pos_embed",
             )
-            x = x + pos_embed(positions)
+            x = x + pos_embed(positions + cfg.pos_offset)
         if cfg.attention_impl == "ring":
             x = _constrain_sequence_parallel(x)
 
@@ -544,7 +607,7 @@ class Transformer(nn.Module):
         else:
             logits = nn.Dense(
                 cfg.vocab_size,
-                use_bias=False,
+                use_bias=cfg.lm_head_bias,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 kernel_init=nn.initializers.normal(0.02),

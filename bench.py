@@ -644,8 +644,9 @@ def main():
         parser.error("--offload-device only applies to --task zero3")
     if overrides and args.task in ("lm", "mrpc"):
         parser.error(
-            f"--batch/--remat-policy/--attention-impl only apply to "
-            f"the zero3/fsdp/cv tasks, not --task {args.task}"
+            "--batch/--remat-policy/--attention-impl only apply to the "
+            "zero3/fsdp/longseq tasks (cv: --batch only), not "
+            f"--task {args.task}"
         )
     if args.task == "mrpc":
         bench_mrpc()

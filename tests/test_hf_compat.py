@@ -114,6 +114,127 @@ class TestLlamaParity:
         np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+class TestOPTParity:
+    """OPT is the BASELINE big-model-inference flagship (OPT-30B,
+    benchmarks/README.md:36-37): pre-LN decoder, +2-offset learned positions,
+    ReLU MLP, biases, tied embeddings."""
+
+    def _save_tiny_opt(self, tmp_path):
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=48, ffn_dim=96, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            dropout=0.0, attention_dropout=0.0, word_embed_proj_dim=48,
+        )
+        torch.manual_seed(2)
+        model = transformers.OPTForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny_opt(tmp_path)
+        rng = np.random.default_rng(4)
+        ids = rng.integers(4, 128, size=(2, 19)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    def test_config_mapping(self, tmp_path):
+        self._save_tiny_opt(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.pos_offset == 2 and cfg.positional == "learned"
+        assert cfg.mlp_variant == "relu" and cfg.use_bias
+        assert cfg.tie_word_embeddings
+
+    def test_decode_matches_torch_generate(self, tmp_path):
+        """KV-cached greedy decode through the streaming engine — the actual
+        OPT-30B workload shape — must be token-exact vs transformers."""
+        from accelerate_tpu.big_modeling import StreamingTransformer
+
+        model_t = self._save_tiny_opt(tmp_path)
+        model, params, device_map, loader = load_hf_checkpoint(
+            str(tmp_path),
+            device_map={m: "cpu" for m in ("embed_tokens", "pos_embed", "layers_0",
+                                           "layers_1", "final_norm")},
+            config_overrides=dict(dtype=jnp.float32, param_dtype=jnp.float32),
+        )
+        streamer = StreamingTransformer(model.config, params, weights_loader=loader)
+        ids = np.arange(4, 12, dtype=np.int64)[None, :]
+        out = streamer.generate(jnp.asarray(ids), max_new_tokens=5)
+        with torch.no_grad():
+            tout = model_t.generate(
+                torch.from_numpy(ids), max_new_tokens=5, do_sample=False,
+                pad_token_id=1,
+            )
+        np.testing.assert_array_equal(np.asarray(out), tout.numpy())
+
+
+class TestGPTJParity:
+    """GPT-J-6B is the BASELINE lead row: parallel residual + SHARED ln,
+    interleaved partial rotary (rotary_dim), biasless attn / biased MLP,
+    untied lm_head WITH bias."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+            rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+        torch.manual_seed(3)
+        model = transformers.GPTJForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, 128, size=(2, 23)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+    def test_config_mapping(self, tmp_path):
+        self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.parallel_residual and cfg.shared_norm
+        assert cfg.rope_interleaved and cfg.rope_dim == 8
+        assert cfg.attn_bias is False and cfg.mlp_bias is True
+        assert cfg.lm_head_bias and not cfg.tie_word_embeddings
+
+
+class TestGPTNeoXParity:
+    """GPT-NeoX-20B row: parallel residual with two norms, head-major fused
+    qkv, rotate-half partial rotary (rotary_pct), exact gelu."""
+
+    def _save_tiny(self, tmp_path, parallel=True):
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+            max_position_embeddings=64, use_parallel_residual=parallel,
+            hidden_dropout=0.0, attention_dropout=0.0,
+        )
+        torch.manual_seed(4)
+        model = transformers.GPTNeoXForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        rng = np.random.default_rng(6)
+        ids = rng.integers(0, 128, size=(2, 15)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+    def test_sequential_residual_variant(self, tmp_path):
+        """use_parallel_residual=false (Pythia-family configs) maps onto the
+        standard sequential block."""
+        model = self._save_tiny(tmp_path, parallel=False)
+        cfg = config_from_hf(str(tmp_path))
+        assert not cfg.parallel_residual
+        ids = np.arange(9, dtype=np.int64)[None, :]
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+
 class TestDispatchIntegration:
     def test_auto_detect_and_dispatch(self, tmp_path):
         """load_checkpoint_and_dispatch pointed at the RAW HF dir: detects,
