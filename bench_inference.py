@@ -104,6 +104,7 @@ def main():
         from accelerate_tpu.utils.modeling import unflatten_tree
 
         ckpt = args.checkpoint
+        t_ckpt_load = time.perf_counter()
         if is_hf_checkpoint(ckpt):
             cfg = config_from_hf(ckpt, dtype=jnp.bfloat16)
             ckpt = convert_hf_checkpoint(ckpt, dtype=jnp.bfloat16)
@@ -117,6 +118,10 @@ def main():
             )
         files = _checkpoint_files(ckpt)
         params = unflatten_tree(_read_tensors(files, list(files)))  # host numpy
+        # the reference's published pairs are (load time, s/token) —
+        # benchmarks/README.md:31-37; conversion is cached so steady-state
+        # load time is the disk -> host read
+        checkpoint_load_s = time.perf_counter() - t_ckpt_load
         preset = f"checkpoint:{os.path.basename(os.path.abspath(args.checkpoint))}"
         model = Transformer(cfg)
         seq = min(args.seq, cfg.max_seq_len)
@@ -179,6 +184,7 @@ def main():
         "seq": seq,
         "bits": args.bits or 16,
         "layers_per_stage": lps,
+        **({"checkpoint_load_s": round(checkpoint_load_s, 2)} if args.checkpoint else {}),
         "platform": jax.devices()[0].platform,
     }
 

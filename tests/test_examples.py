@@ -148,6 +148,11 @@ def test_feature_schedule_free():
     assert "eval_acc(schedule-free params)" in out
 
 
+def test_inference_hf_checkpoint_generate():
+    out = run_example("inference/hf_checkpoint_generate.py", "--max_new_tokens", "4")
+    assert "hf_checkpoint_generate: OK" in out
+
+
 def test_inference_distributed_generate():
     out = run_example("inference/distributed_generate.py")
     assert "8 continuations generated" in out
