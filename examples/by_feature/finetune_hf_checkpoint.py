@@ -1,0 +1,96 @@
+"""Feature: fine-tune a REAL Hugging Face checkpoint.
+
+The reference's training story starts from `AutoModel.from_pretrained`; the
+TPU-native equivalent is: raw HF snapshot -> key/layout conversion
+(models/hf_compat) -> restack for `scan_layers=True` (`to_scan_layout`) ->
+the compiled Accelerator train step, and back out through `save_model`
+(sharded safetensors).
+
+Run:  python examples/by_feature/finetune_hf_checkpoint.py
+(zero-egress rigs: a tiny GPT-2 snapshot in genuine HF format is generated
+locally; pass --checkpoint for a downloaded snapshot of any mapped family.)
+"""
+
+import argparse
+import dataclasses
+import os
+import sys
+import tempfile
+
+_EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(_EXAMPLES))  # repo root (accelerate_tpu)
+sys.path.insert(0, _EXAMPLES)                   # shared example helpers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, set_seed
+from accelerate_tpu.big_modeling import _checkpoint_files, _read_tensors
+from accelerate_tpu.models.hf_compat import (
+    config_from_hf,
+    convert_hf_checkpoint,
+    to_scan_layout,
+)
+from accelerate_tpu.models.transformer import Transformer, lm_loss_fn
+from accelerate_tpu.utils.modeling import unflatten_tree
+from hf_snapshot_util import make_tiny_snapshot
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--checkpoint", default=None)
+    parser.add_argument("--steps", type=int, default=30)
+    args = parser.parse_args()
+    set_seed(42)
+
+    tmp = None
+    ckpt = args.checkpoint
+    if ckpt is None:
+        tmp = tempfile.TemporaryDirectory()
+        ckpt = make_tiny_snapshot(tmp.name)
+
+    # 1. convert (cached) and load the real weights host-side
+    cfg = config_from_hf(ckpt, dtype=jnp.bfloat16)
+    native = convert_hf_checkpoint(ckpt)
+    files = _checkpoint_files(native)
+    params = unflatten_tree(_read_tensors(files, list(files)))
+
+    # 2. restack the per-layer tree for the scanned training layout
+    scan_cfg = dataclasses.replace(cfg, scan_layers=True, remat=True)
+    params = to_scan_layout(params, cfg.num_layers)
+    model = Transformer(scan_cfg)
+
+    # 3. standard compiled fine-tune loop (bf16 policy, clip, adamw)
+    acc = Accelerator(mixed_precision="bf16")
+    state = acc.create_train_state(params=params, tx=optax.adamw(3e-4), seed=0)
+    step = acc.compile_train_step(lm_loss_fn(model), max_grad_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    # a learnable synthetic task: fixed repeated segments
+    seq = rng.integers(0, cfg.vocab_size, 16)
+    ids = jnp.asarray(np.tile(seq, (8, 4))[:, :64], jnp.int32)
+    batch = {"input_ids": ids}
+
+    first = None
+    for i in range(args.steps):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    print(f"fine-tune loss {first:.3f} -> {last:.3f} over {args.steps} steps")
+    assert last < first, "loss must improve from the pretrained point"
+
+    # 4. export the tuned weights (sharded safetensors, HF-compatible naming)
+    with tempfile.TemporaryDirectory() as out:
+        acc.save_model(state, out)
+        saved = os.listdir(out)
+        print(f"saved tuned model: {sorted(saved)}")
+    print("finetune_hf_checkpoint: OK")
+    if tmp is not None:
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Shared helper for the HF-interop examples: generate a tiny GPT-2 snapshot
+in genuine HF format (config.json + safetensors, real key naming) so the
+examples are self-contained on zero-egress rigs."""
+
+
+def make_tiny_snapshot(path: str) -> str:
+    import torch
+    import transformers
+
+    cfg = transformers.GPT2Config(vocab_size=256, n_positions=64, n_embd=64,
+                                  n_layer=2, n_head=4)
+    torch.manual_seed(0)
+    transformers.GPT2LMHeadModel(cfg).save_pretrained(path, safe_serialization=True)
+    return path

@@ -18,25 +18,15 @@ import os
 import sys
 import tempfile
 
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-)
+_EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(_EXAMPLES))  # repo root (accelerate_tpu)
+sys.path.insert(0, _EXAMPLES)                   # shared example helpers
 
 import jax.numpy as jnp
 import numpy as np
 
 from accelerate_tpu import StreamingTransformer, load_hf_checkpoint
-
-
-def make_tiny_snapshot(path: str) -> str:
-    import torch
-    import transformers
-
-    cfg = transformers.GPT2Config(vocab_size=256, n_positions=64, n_embd=64,
-                                  n_layer=2, n_head=4)
-    torch.manual_seed(0)
-    transformers.GPT2LMHeadModel(cfg).save_pretrained(path, safe_serialization=True)
-    return path
+from hf_snapshot_util import make_tiny_snapshot
 
 
 def main():

@@ -109,6 +109,11 @@ def test_feature_big_model_inference():
     assert "host-streamed" in out
 
 
+def test_feature_finetune_hf_checkpoint():
+    out = run_example("by_feature/finetune_hf_checkpoint.py", "--steps", "12")
+    assert "finetune_hf_checkpoint: OK" in out
+
+
 def test_feature_streaming_hooks():
     out = run_example("by_feature/streaming_hooks.py")
     assert "streaming_hooks example: OK" in out
