@@ -1,4 +1,10 @@
-"""Parallelism substrate: named meshes, sharding rules, collectives, model parallel."""
+"""Parallelism substrate: named meshes, sharding rules, model parallel.
+
+In-step collectives are XLA ops: emitted automatically from shardings in the
+common case, or written as ``jax.lax.psum``/``ppermute``/``all_to_all`` inside
+``shard_map`` where schedules are hand-written (ring attention, MoE dispatch,
+PowerSGD) — there is no separate communication backend to wrap (SURVEY §2.6).
+"""
 
 from .compression import compressed_pmean, compression_stats, powersgd_init
 from .moe import MoEMLP, router_aux_loss, shard_moe_params, top_k_dispatch

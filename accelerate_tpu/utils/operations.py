@@ -5,10 +5,10 @@ LoC).  The reference implements per-backend collectives (``_gpu_gather`` /
 ``_tpu_gather``, ``operations.py:308-358``) applied over pytrees via
 ``recursively_apply`` (``:84-133``).  Here there are two distinct layers:
 
-1. **In-step collectives** (inside ``jit``/``shard_map``) are XLA ops — see
-   ``accelerate_tpu.parallel.collectives``.  Most reference call-sites (grad
-   all-reduce, loss averaging) disappear into the compiled step: XLA emits them
-   from shardings.
+1. **In-step collectives** (inside ``jit``/``shard_map``) are XLA ops
+   (``jax.lax.psum`` etc., written directly where schedules are hand-built).
+   Most reference call-sites (grad all-reduce, loss averaging) disappear into
+   the compiled step: XLA emits them from shardings.
 
 2. **Host-level operations** (this module) work on *materialized* values between
    steps: ``gather``/``reduce``/``broadcast``/``pad_across_processes`` over pytrees of

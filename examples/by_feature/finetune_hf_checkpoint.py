@@ -21,20 +21,18 @@ _EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(_EXAMPLES))  # repo root (accelerate_tpu)
 sys.path.insert(0, _EXAMPLES)                   # shared example helpers
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
 from accelerate_tpu import Accelerator, set_seed
-from accelerate_tpu.big_modeling import _checkpoint_files, _read_tensors
+from accelerate_tpu.checkpointing import load_model_params
 from accelerate_tpu.models.hf_compat import (
     config_from_hf,
     convert_hf_checkpoint,
     to_scan_layout,
 )
 from accelerate_tpu.models.transformer import Transformer, lm_loss_fn
-from accelerate_tpu.utils.modeling import unflatten_tree
 from hf_snapshot_util import make_tiny_snapshot
 
 
@@ -43,6 +41,8 @@ def main():
     parser.add_argument("--checkpoint", default=None)
     parser.add_argument("--steps", type=int, default=30)
     args = parser.parse_args()
+    if args.steps < 1:
+        parser.error("--steps must be >= 1")
     set_seed(42)
 
     tmp = None
@@ -54,8 +54,7 @@ def main():
     # 1. convert (cached) and load the real weights host-side
     cfg = config_from_hf(ckpt, dtype=jnp.bfloat16)
     native = convert_hf_checkpoint(ckpt)
-    files = _checkpoint_files(native)
-    params = unflatten_tree(_read_tensors(files, list(files)))
+    params = load_model_params(native)
 
     # 2. restack the per-layer tree for the scanned training layout
     scan_cfg = dataclasses.replace(cfg, scan_layers=True, remat=True)
