@@ -834,17 +834,19 @@ class StreamingTransformer(StreamingExecutor):
         def embed_fn(stage_params, ids, positions):
             import flax.linen as nn
 
+            from .models.transformer import scale_embed
+
             embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
             if getattr(cfg, "positional", "rope") == "learned":
                 embed_params, pos_params = stage_params
-                x = embed.apply({"params": embed_params}, ids)
+                x = scale_embed(cfg, embed.apply({"params": embed_params}, ids))
                 offset = getattr(cfg, "pos_offset", 0)
                 pos = nn.Embed(
                     cfg.max_seq_len + offset, cfg.hidden_size,
                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 )
                 return x + pos.apply({"params": pos_params}, positions + offset), positions
-            return embed.apply({"params": stage_params}, ids), positions
+            return scale_embed(cfg, embed.apply({"params": stage_params}, ids)), positions
 
         def head_fn(stage_params, x, positions):
             import flax.linen as nn

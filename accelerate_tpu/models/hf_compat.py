@@ -44,7 +44,9 @@ __all__ = [
 ]
 
 # architectures with a key mapping; config.json "model_type" values
-SUPPORTED_MODEL_TYPES = ("gpt2", "llama", "opt", "gptj", "gpt_neox")
+SUPPORTED_MODEL_TYPES = (
+    "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
+)
 
 
 def _read_hf_config(checkpoint: str) -> Dict[str, Any]:
@@ -70,6 +72,26 @@ def config_from_hf(checkpoint: str, **overrides) -> TransformerConfig:
         with open(stamp_path) as f:
             return _config_from_hf_dict(json.load(f)["source_config"], **overrides)
     return _config_from_hf_dict(_read_hf_config(checkpoint), **overrides)
+
+
+def _llama_base_fields(
+    hf: Dict[str, Any], max_seq_default: int = 4096, eps_default: float = 1e-5
+) -> Dict[str, Any]:
+    """The shared Llama-recipe config core (llama/mistral/qwen2/gemma all
+    speak these 11 keys; family deltas layer on top)."""
+    return dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        max_seq_len=hf.get("max_position_embeddings", max_seq_default),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", eps_default),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+    )
 
 
 def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
@@ -182,21 +204,58 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             mlp_variant="gelu_exact" if act == "gelu" else "gelu",
         )
     elif model_type == "llama":
+        fields = _llama_base_fields(hf)
+        # HF keeps these independent (llamafied Qwen exports use attention
+        # biases only); the per-site switches keep the key map exact
+        if hf.get("attention_bias", False):
+            fields["attn_bias"] = True
+        if hf.get("mlp_bias", False):
+            fields["mlp_bias"] = True
+    elif model_type in ("mistral", "qwen2"):
+        # Llama recipe with two deltas: sliding-window attention (Mistral
+        # always when config.sliding_window is set; Qwen2 behind
+        # use_sliding_window), and Qwen2's q/k/v-only projection biases.
+        fields = _llama_base_fields(hf)
+        if model_type == "qwen2":
+            fields["qkv_bias"] = True  # modeling_qwen2: bias on q/k/v, not o/MLP
+            if hf.get("use_sliding_window", False):
+                # HF semantics: the FIRST max_window_layers layers use full
+                # attention; only layers beyond that use the sliding window
+                # (Qwen2Config default 28)
+                n = hf["num_hidden_layers"]
+                mwl = hf.get("max_window_layers", 28)
+                if mwl >= n:
+                    pass  # every layer is full attention
+                elif mwl <= 0:
+                    fields["sliding_window"] = hf.get("sliding_window")
+                else:
+                    raise NotImplementedError(
+                        "qwen2 per-layer mixed attention (first "
+                        f"max_window_layers={mwl} of {n} layers full, the "
+                        "rest sliding) is not mapped; sliding_window here is "
+                        "uniform across layers"
+                    )
+        else:
+            # MistralConfig reconstructs an absent key as 4096 — a json that
+            # omits it still means the 4096 window, not full attention
+            fields["sliding_window"] = hf.get("sliding_window", 4096)
+    elif model_type == "gemma":
+        act = hf.get("hidden_activation") or hf.get("hidden_act", "gelu_pytorch_tanh")
+        if act not in ("gelu_pytorch_tanh", "gelu_new"):
+            # plain "gelu" would be the erf form — a different gate function
+            raise NotImplementedError(f"gemma hidden activation {act!r} is not mapped")
         fields = dict(
-            vocab_size=hf["vocab_size"],
-            hidden_size=hf["hidden_size"],
-            intermediate_size=hf["intermediate_size"],
-            num_layers=hf["num_hidden_layers"],
-            num_heads=hf["num_attention_heads"],
-            num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
-            head_dim=hf.get("head_dim"),
-            max_seq_len=hf.get("max_position_embeddings", 4096),
-            rope_theta=hf.get("rope_theta", 10000.0),
-            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
-            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            _llama_base_fields(hf, max_seq_default=8192, eps_default=1e-6),
+            # Gemma always ties; the family switches: (1+scale) RMSNorm with
+            # zeros-init offset params, sqrt(hidden) embedding scale, tanh-gelu
+            # gated MLP
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            norm_unit_offset=True,
+            embed_scale=True,
+            mlp_variant="geglu",
         )
-        if hf.get("attention_bias", False) or hf.get("mlp_bias", False):
-            fields["use_bias"] = True
+        if hf.get("attention_bias", False):
+            fields["attn_bias"] = True
     else:
         raise NotImplementedError(
             f"model_type {model_type!r} has no key mapping; supported: "
@@ -394,15 +453,21 @@ def gpt_neox_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
 
 
 def llama_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
-    """HF Llama naming (``model.layers.{i}.self_attn...``) → native tree.
-    HF Llama uses the rotate-half rope convention, which ``_rope`` implements
-    directly — weights need no permutation, only the Linear transpose."""
+    """HF Llama naming (``model.layers.{i}.self_attn...``) → native tree;
+    also serves Mistral, Qwen2 and Gemma, which share it exactly (their
+    deltas — sliding window, q/k/v biases, unit-offset norms — are config
+    switches, not key renames).  HF Llama uses the rotate-half rope
+    convention, which ``_rope`` implements directly — weights need no
+    permutation, only the Linear transpose."""
     m: Dict[str, Tuple[str, Callable]] = {
         "embed_tokens.embedding": ("model.embed_tokens.weight", _ident),
         "final_norm.scale": ("model.norm.weight", _ident),
     }
     if not cfg.tie_word_embeddings:
         m["lm_head.kernel"] = ("lm_head.weight", _t)
+    attn_b = cfg.attn_bias if cfg.attn_bias is not None else cfg.use_bias
+    qkv_b = cfg.qkv_bias if cfg.qkv_bias is not None else attn_b
+    mlp_b = cfg.mlp_bias if cfg.mlp_bias is not None else cfg.use_bias
     for i in range(cfg.num_layers):
         n, h = f"layers_{i}", f"model.layers.{i}"
         m.update({
@@ -411,11 +476,11 @@ def llama_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
         })
         for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
             m[f"{n}.attn.{proj}.kernel"] = (f"{h}.self_attn.{proj}.weight", _t)
-            if cfg.use_bias:
+            if (qkv_b if proj != "o_proj" else attn_b):
                 m[f"{n}.attn.{proj}.bias"] = (f"{h}.self_attn.{proj}.bias", _ident)
         for proj in ("gate_proj", "up_proj", "down_proj"):
             m[f"{n}.mlp.{proj}.kernel"] = (f"{h}.mlp.{proj}.weight", _t)
-            if cfg.use_bias:
+            if mlp_b:
                 m[f"{n}.mlp.{proj}.bias"] = (f"{h}.mlp.{proj}.bias", _ident)
     return m
 

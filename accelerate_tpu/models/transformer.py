@@ -108,8 +108,9 @@ class TransformerConfig:
     use_bias: bool = False             # biases on attention/MLP projections
     positional: str = "rope"           # "rope" | "learned" (wpe-style table)
     # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact" the erf
-    # form (GPT-NeoX); "relu" the OPT family
-    mlp_variant: str = "swiglu"        # "swiglu" | "gelu" | "gelu_exact" | "relu"
+    # form (GPT-NeoX); "relu" the OPT family; "geglu" the gated variant with
+    # a tanh-gelu gate (Gemma) — same three-matrix layout as swiglu
+    mlp_variant: str = "swiglu"        # "swiglu" | "gelu" | "gelu_exact" | "relu" | "geglu"
     # Learned-position table offset: OPT reserves the first 2 rows (padding
     # convention), so position i reads row i+2 and the table has
     # max_seq_len + pos_offset rows.
@@ -130,6 +131,16 @@ class TransformerConfig:
     attn_bias: Optional[bool] = None
     mlp_bias: Optional[bool] = None
     lm_head_bias: bool = False
+    # Qwen2-family: bias on q/k/v only (o_proj and MLP stay biasless).
+    # None falls back to attn_bias / use_bias.
+    qkv_bias: Optional[bool] = None
+    # Mistral-family sliding-window attention: each token sees the previous
+    # ``sliding_window`` positions (self included).  None = full causal.
+    sliding_window: Optional[int] = None
+    # Gemma-family switches: RMSNorm computes (1 + scale) with zeros-init
+    # scale, and embeddings are multiplied by sqrt(hidden_size).
+    norm_unit_offset: bool = False
+    embed_scale: bool = False
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False                # jax.checkpoint each layer
@@ -191,11 +202,13 @@ class TransformerConfig:
             raise ValueError(
                 f"Unknown positional {self.positional!r}; choose 'rope' or 'learned'"
             )
-        if self.mlp_variant not in ("swiglu", "gelu", "gelu_exact", "relu"):
+        if self.mlp_variant not in ("swiglu", "gelu", "gelu_exact", "relu", "geglu"):
             raise ValueError(
                 f"Unknown mlp_variant {self.mlp_variant!r}; choose 'swiglu', "
-                "'gelu', 'gelu_exact' or 'relu'"
+                "'gelu', 'gelu_exact', 'relu' or 'geglu'"
             )
+        if self.sliding_window is not None and self.sliding_window <= 0:
+            raise ValueError(f"sliding_window must be positive, got {self.sliding_window}")
 
     @classmethod
     def llama2_7b(cls, **kw):
@@ -270,17 +283,18 @@ class KVCache(struct.PyTreeNode):
         return self.k.shape[2]
 
 
-def cached_attention(q, k, v, q_positions):
+def cached_attention(q, k, v, q_positions, window=None):
     """Attention of ``q`` [B,S,Hq,D] against a full cache ``k``/``v`` [B,M,Hkv,D].
 
     Key slot ``j`` is visible to query ``i`` iff ``j <= q_positions[i]`` —
     since the cache is written contiguously from 0, this is simultaneously the
     causal mask and the valid-entry mask (unwritten slots have ``j`` beyond
-    every query position).  Runs as a masked einsum: decode queries are tiny
-    (S=1) and prefill blocks fuse fine on the MXU; fp32 softmax.  GQA groups
-    fold into the query tensor (``[B,S,Hkv,rep,D]``) so the cache is contracted
-    UNexpanded — a ``jnp.repeat`` of K/V would multiply the per-token HBM reads
-    by the query/kv head ratio on the decode hot path.
+    every query position).  ``window`` adds the sliding-window band (Mistral):
+    ``j > q_positions[i] - window``.  Runs as a masked einsum: decode queries
+    are tiny (S=1) and prefill blocks fuse fine on the MXU; fp32 softmax.  GQA
+    groups fold into the query tensor (``[B,S,Hkv,rep,D]``) so the cache is
+    contracted UNexpanded — a ``jnp.repeat`` of K/V would multiply the
+    per-token HBM reads by the query/kv head ratio on the decode hot path.
     """
     b, s, n_q, d = q.shape
     n_kv = k.shape[2]
@@ -290,6 +304,10 @@ def cached_attention(q, k, v, q_positions):
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
     j = jnp.arange(k.shape[1])
     mask = j[None, None, None, None, :] <= q_positions[:, None, None, :, None]  # [B,1,1,S,M]
+    if window is not None:
+        mask = mask & (
+            j[None, None, None, None, :] > q_positions[:, None, None, :, None] - window
+        )
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
@@ -338,15 +356,30 @@ def _apply_rope(x: jax.Array, positions: jax.Array, cfg: "TransformerConfig") ->
     return jnp.concatenate([rotated, x[..., rd:]], axis=-1)
 
 
+def scale_embed(cfg: "TransformerConfig", x: jax.Array) -> jax.Array:
+    """Gemma-family sqrt(hidden) embedding scale (identity unless
+    ``cfg.embed_scale``) — single source for the monolithic forward, the
+    streaming embed stage, and both pipeline embed sites."""
+    if getattr(cfg, "embed_scale", False):
+        return x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+    return x
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     param_dtype: Any = jnp.float32
+    # Gemma convention: the stored parameter is an offset from 1 (zeros-init),
+    # output = normed * (1 + scale) — matches HF's GemmaRMSNorm weights as-is.
+    unit_offset: bool = False
 
     @nn.compact
     def __call__(self, x):
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        init = nn.initializers.zeros if self.unit_offset else nn.initializers.ones
+        scale = self.param("scale", init, (x.shape[-1],), self.param_dtype)
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
         normed = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        if self.unit_offset:
+            scale = 1.0 + scale
         return (normed * scale).astype(x.dtype)
 
 
@@ -368,12 +401,13 @@ class LayerNorm(nn.Module):
         return (normed * scale + bias).astype(x.dtype)
 
 
-def make_norm(cfg: "TransformerConfig", name: str):
+def make_norm(cfg: "TransformerConfig", name: Optional[str] = None):
     """The config-selected norm module — single source for DecoderLayer, the
-    final norm, and big_modeling's streaming head stage."""
+    final norm, big_modeling's streaming head stage, and the pipeline head
+    (``name=None`` for root-level ``.apply``, where flax forbids names)."""
     if cfg.norm_type == "layernorm":
         return LayerNorm(cfg.rms_norm_eps, cfg.param_dtype, name=name)
-    return RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name=name)
+    return RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, cfg.norm_unit_offset, name=name)
 
 
 class Attention(nn.Module):
@@ -388,9 +422,14 @@ class Attention(nn.Module):
         cfg = self.config
         hd = cfg.resolved_head_dim
         dense = functools_partial_dense(cfg, use_bias=cfg.attn_bias)
-        q = _tag_proj(dense("q_proj", cfg.num_heads * hd)(x))
-        k = _tag_proj(dense("k_proj", cfg.num_kv_heads * hd)(x))
-        v = _tag_proj(dense("v_proj", cfg.num_kv_heads * hd)(x))
+        # Qwen2: q/k/v biased, o_proj not — qkv_bias overrides for the three
+        # input projections only
+        dense_qkv = dense if cfg.qkv_bias is None else functools_partial_dense(
+            cfg, use_bias=cfg.qkv_bias
+        )
+        q = _tag_proj(dense_qkv("q_proj", cfg.num_heads * hd)(x))
+        k = _tag_proj(dense_qkv("k_proj", cfg.num_kv_heads * hd)(x))
+        v = _tag_proj(dense_qkv("v_proj", cfg.num_kv_heads * hd)(x))
         b, s = x.shape[:2]
         q = q.reshape(b, s, cfg.num_heads, hd)
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
@@ -406,12 +445,13 @@ class Attention(nn.Module):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v.astype(v_cache.dtype), (0, index, 0, 0)
             )
-            out = cached_attention(q, k_cache, v_cache, positions)
+            out = cached_attention(q, k_cache, v_cache, positions, window=cfg.sliding_window)
             out = out.reshape(b, s, cfg.num_heads * hd)
             return dense("o_proj", cfg.hidden_size)(out), (k_cache, v_cache)
         out = dot_product_attention(
             q, k, v, causal=True, implementation=cfg.attention_impl,
-            segment_ids=segment_ids, ring_layout=cfg.ring_attention_layout
+            segment_ids=segment_ids, ring_layout=cfg.ring_attention_layout,
+            window=cfg.sliding_window,
         )
         out = out.reshape(b, s, cfg.num_heads * hd)
         return _tag_proj(dense("o_proj", cfg.hidden_size)(out))
@@ -482,7 +522,9 @@ class MLP(nn.Module):
             return _tag_proj(dense("down_proj", cfg.hidden_size)(act(up)))
         gate = _tag_proj(dense("gate_proj", cfg.intermediate_size)(x))
         up = _tag_proj(dense("up_proj", cfg.intermediate_size)(x), "proj_wide")
-        return _tag_proj(dense("down_proj", cfg.hidden_size)(nn.silu(gate) * up))
+        # swiglu: silu gate (Llama); geglu: tanh-gelu gate (Gemma)
+        gated = nn.gelu(gate, approximate=True) if cfg.mlp_variant == "geglu" else nn.silu(gate)
+        return _tag_proj(dense("down_proj", cfg.hidden_size)(gated * up))
 
 
 class DecoderLayer(nn.Module):
@@ -541,7 +583,7 @@ class Transformer(nn.Module):
             embedding_init=nn.initializers.normal(0.02),
             name="embed_tokens",
         )
-        x = embed(input_ids)
+        x = scale_embed(cfg, embed(input_ids))
         if cfg.positional == "learned":
             pos_embed = nn.Embed(
                 cfg.max_seq_len + cfg.pos_offset,

@@ -48,8 +48,24 @@ def dot_product_attention(
     implementation: str = "xla",
     segment_ids: Optional[jax.Array] = None,
     ring_layout: str = "contiguous",
+    window: Optional[int] = None,
 ) -> jax.Array:
-    """BSHD attention. GQA supported (k/v may have fewer heads than q)."""
+    """BSHD attention. GQA supported (k/v may have fewer heads than q).
+
+    ``window`` enables sliding-window attention (Mistral-family,
+    ``config.sliding_window``): query ``i`` sees keys ``j`` with
+    ``i - window < j <= i`` — the causal band of width ``window`` including
+    self.  Currently the ``"xla"`` implementation only; the banded mask
+    composes with ``segment_ids``.
+    """
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires causal=True")
+        if implementation != "xla":
+            raise NotImplementedError(
+                f"window (sliding-window attention) is implemented for "
+                f"implementation='xla' only, got {implementation!r}."
+            )
     if implementation == "pallas":
         from .flash_attention import flash_attention
 
@@ -132,6 +148,12 @@ def dot_product_attention(
     if segment_ids is not None:
         # packed sequences: tokens attend only within their own segment
         mask = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None, :, :]
+    if window is not None:
+        # banded causal: i - j < window (the causal half rides is_causal below)
+        i = jnp.arange(q.shape[1])[:, None]
+        j = jnp.arange(k.shape[1])[None, :]
+        band = ((i - j) < window)[None, None, :, :]
+        mask = band if mask is None else (mask & band)
     try:
         return jax.nn.dot_product_attention(
             q, k, v, mask=mask, is_causal=causal, scale=scale, implementation=None

@@ -368,7 +368,7 @@ def _pipeline_1f1b_lm_loss(model, mesh, num_microbatches, axis):
     """
     import flax.linen as nn
 
-    from ..models.transformer import RMSNorm, shift_labels
+    from ..models.transformer import make_norm, scale_embed, shift_labels
 
     cfg = model.config
     is_moe = getattr(cfg, "num_experts", 0) > 0 and cfg.router_aux_loss_coef > 0.0
@@ -379,13 +379,11 @@ def _pipeline_1f1b_lm_loss(model, mesh, num_microbatches, axis):
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype
         )
-        return embed.apply({"params": p_embed}, tokens)
+        return scale_embed(cfg, embed.apply({"params": p_embed}, tokens))
 
     def head_nll(p_head, x, labels):
         """Unreduced token NLL sum for one microbatch (fp32)."""
-        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply(
-            {"params": p_head["final_norm"]}, x
-        )
+        x = make_norm(cfg).apply({"params": p_head["final_norm"]}, x)
         if cfg.tie_word_embeddings:
             embed = nn.Embed(
                 cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype
@@ -631,7 +629,7 @@ def prepare_pipeline(
     (``(logits, per_microbatch_aux)`` with ``with_aux`` — the MoE router
     path).
     """
-    from ..models.transformer import RMSNorm
+    from ..models.transformer import make_norm, scale_embed
     import flax.linen as nn
 
     cfg = model.config
@@ -647,7 +645,7 @@ def prepare_pipeline(
         b_p = b + pad
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b_p // M, s))
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-        x = embed.apply({"params": p["embed_tokens"]}, input_ids)
+        x = scale_embed(cfg, embed.apply({"params": p["embed_tokens"]}, input_ids))
         mbs = x.reshape(M, b_p // M, s, cfg.hidden_size)
         layer_params = stack_layer_params(p, cfg.num_layers)
         out = pipeline_apply(
@@ -658,7 +656,7 @@ def prepare_pipeline(
         if with_aux:
             out, aux = out
         x = out.reshape(b_p, s, cfg.hidden_size)[:b]
-        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype).apply({"params": p["final_norm"]}, x)
+        x = make_norm(cfg).apply({"params": p["final_norm"]}, x)
         if cfg.tie_word_embeddings:
             # exact monolithic semantics: embed.attend promotes to cfg.dtype
             # (models/transformer.py:208)

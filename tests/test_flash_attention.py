@@ -156,6 +156,33 @@ def test_segment_ids_gradients(qkv):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5 * max(scale, 1.0))
 
 
+class TestSlidingWindow:
+    """window= (Mistral sliding-window attention) on the xla path."""
+
+    def test_matches_banded_reference(self, qkv):
+        q, k, v = qkv
+        w = 32
+        out = dot_product_attention(q, k, v, causal=True, implementation="xla", window=w)
+        i = jnp.arange(S)[:, None]
+        j = jnp.arange(S)[None, :]
+        mask = ((j <= i) & (i - j < w))[None, None, :, :]
+        ref = _reference_attention(q, k, v, causal=False, scale=None, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_window_of_seq_is_full_causal(self, qkv):
+        q, k, v = qkv
+        out = dot_product_attention(q, k, v, causal=True, implementation="xla", window=S)
+        ref = _reference_attention(q, k, v, causal=True, scale=None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_rejected_elsewhere(self, qkv):
+        q, k, v = qkv
+        with pytest.raises(NotImplementedError, match="window"):
+            dot_product_attention(q, k, v, implementation="pallas", window=8)
+        with pytest.raises(ValueError, match="causal"):
+            dot_product_attention(q, k, v, causal=False, implementation="xla", window=8)
+
+
 def test_dispatch_through_attention_entry_point(qkv):
     q, k, v = qkv
     out = dot_product_attention(q, k, v, causal=True, implementation="pallas")

@@ -113,6 +113,30 @@ class TestLlamaParity:
         ref = _torch_logits(model, ids)
         np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
+    def test_attention_bias_only(self, tmp_path):
+        """attention_bias=True with mlp_bias=False (llamafied-Qwen exports):
+        the per-site switches must not demand MLP bias keys the checkpoint
+        lacks, and logits must still match."""
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, attention_bias=True,
+        )
+        torch.manual_seed(12)
+        model = transformers.LlamaForCausalLM(cfg).eval()
+        # biases init to zero — nudge them so a dropped bias shows up
+        with torch.no_grad():
+            for layer in model.model.layers:
+                for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                             layer.self_attn.v_proj, layer.self_attn.o_proj):
+                    proj.bias.normal_(std=0.05)
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        ncfg = config_from_hf(str(tmp_path))
+        assert ncfg.attn_bias is True and ncfg.mlp_bias is None and not ncfg.use_bias
+        ids = np.arange(11, dtype=np.int64)[None, :]
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
 
 class TestOPTParity:
     """OPT is the BASELINE big-model-inference flagship (OPT-30B,
@@ -233,6 +257,184 @@ class TestGPTNeoXParity:
         ids = np.arange(9, dtype=np.int64)[None, :]
         ours = _flax_logits(str(tmp_path), ids)
         np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+
+class TestMistralParity:
+    """Mistral-7B family: Llama recipe + sliding-window attention.  The tiny
+    config uses window 8 < seq so the band actually masks (a wrong window
+    semantics shows up as logits divergence past position 8)."""
+
+    def _save_tiny(self, tmp_path, window=8):
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, sliding_window=window,
+            attn_implementation="eager",
+        )
+        torch.manual_seed(7)
+        model = transformers.MistralForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch_beyond_window(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, 128, size=(2, 21)).astype(np.int64)  # 21 > window 8
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+    def test_config_mapping(self, tmp_path):
+        self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.sliding_window == 8
+        assert cfg.norm_type == "rmsnorm" and cfg.mlp_variant == "swiglu"
+        assert not cfg.use_bias
+
+    def test_absent_window_key_defaults_to_4096(self):
+        """A config.json omitting sliding_window means the MistralConfig
+        default window (4096), NOT full attention."""
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        hf = dict(model_type="mistral", vocab_size=128, hidden_size=64,
+                  intermediate_size=160, num_hidden_layers=2,
+                  num_attention_heads=4)
+        assert _config_from_hf_dict(hf).sliding_window == 4096
+        hf["sliding_window"] = None  # explicit null disables it
+        assert _config_from_hf_dict(hf).sliding_window is None
+
+    def test_decode_matches_torch_generate(self, tmp_path):
+        """KV-cached decode past the window: cached_attention's banded mask
+        must match transformers' rolling-window semantics token-exactly."""
+        from accelerate_tpu.big_modeling import StreamingTransformer
+
+        model_t = self._save_tiny(tmp_path)
+        model, params, device_map, loader = load_hf_checkpoint(
+            str(tmp_path),
+            device_map={m: "cpu" for m in ("embed_tokens", "layers_0",
+                                           "layers_1", "final_norm", "lm_head")},
+            config_overrides=dict(dtype=jnp.float32, param_dtype=jnp.float32),
+        )
+        streamer = StreamingTransformer(model.config, params, weights_loader=loader)
+        ids = np.arange(3, 15, dtype=np.int64)[None, :]  # prompt 12 > window 8
+        out = streamer.generate(jnp.asarray(ids), max_new_tokens=6)
+        with torch.no_grad():
+            tout = model_t.generate(
+                torch.from_numpy(ids), max_new_tokens=6, do_sample=False,
+                pad_token_id=1,
+            )
+        np.testing.assert_array_equal(np.asarray(out), tout.numpy())
+
+
+class TestQwen2Parity:
+    """Qwen2 family: Llama recipe + biases on q/k/v only (o_proj and MLP
+    biasless) — exercises the per-projection qkv_bias switch."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64,
+        )
+        torch.manual_seed(8)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        rng = np.random.default_rng(8)
+        ids = rng.integers(0, 128, size=(2, 17)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+    def test_config_mapping(self, tmp_path):
+        self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.qkv_bias is True
+        assert not cfg.use_bias and cfg.attn_bias is None and cfg.mlp_bias is None
+        assert cfg.sliding_window is None  # use_sliding_window defaults False
+
+    def test_max_window_layers_semantics(self, tmp_path):
+        """HF: the first max_window_layers layers are FULL attention; only
+        layers beyond use the window.  mwl >= num_layers -> no sliding window
+        at all (and matches torch logits); a genuinely mixed config raises."""
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, use_sliding_window=True,
+            sliding_window=4, max_window_layers=2, attn_implementation="eager",
+        )
+        torch.manual_seed(11)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        ncfg = config_from_hf(str(tmp_path))
+        assert ncfg.sliding_window is None  # every layer full attention
+        ids = np.arange(2, 18, dtype=np.int64)[None, :]  # 16 tokens > window 4
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        mixed = json.loads(cfg.to_json_string())
+        mixed["max_window_layers"] = 1
+        with pytest.raises(NotImplementedError, match="max_window_layers"):
+            _config_from_hf_dict(mixed)
+
+
+class TestGemmaParity:
+    """Gemma family: (1+scale) RMSNorm with zeros-init offset, sqrt(hidden)
+    embedding scale, tanh-gelu gated MLP, tied embeddings, free head_dim."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+            head_dim=24, max_position_embeddings=64,
+            attn_implementation="eager",
+        )
+        torch.manual_seed(9)
+        model = transformers.GemmaForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        rng = np.random.default_rng(9)
+        ids = rng.integers(0, 128, size=(2, 13)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        ref = _torch_logits(model, ids)
+        np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+    def test_config_mapping(self, tmp_path):
+        self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.norm_unit_offset and cfg.embed_scale
+        assert cfg.mlp_variant == "geglu" and cfg.tie_word_embeddings
+        assert cfg.head_dim == 24  # decoupled from hidden // heads (= 16)
+
+    def test_decode_matches_torch_generate(self, tmp_path):
+        """Streamed KV-cached decode: the streaming embed stage must apply
+        the sqrt(hidden) scale too."""
+        from accelerate_tpu.big_modeling import StreamingTransformer
+
+        model_t = self._save_tiny(tmp_path)
+        model, params, device_map, loader = load_hf_checkpoint(
+            str(tmp_path),
+            device_map={m: "cpu" for m in ("embed_tokens", "layers_0",
+                                           "layers_1", "final_norm")},
+            config_overrides=dict(dtype=jnp.float32, param_dtype=jnp.float32),
+        )
+        streamer = StreamingTransformer(model.config, params, weights_loader=loader)
+        ids = np.arange(5, 13, dtype=np.int64)[None, :]
+        out = streamer.generate(jnp.asarray(ids), max_new_tokens=5)
+        with torch.no_grad():
+            tout = model_t.generate(
+                torch.from_numpy(ids), max_new_tokens=5, do_sample=False,
+                pad_token_id=1,
+            )
+        np.testing.assert_array_equal(np.asarray(out), tout.numpy())
 
 
 class TestDispatchIntegration:
