@@ -45,3 +45,4 @@ bench:
 
 quality:
 	python -m compileall -q accelerate_tpu
+	python tools/check_reference_citations.py

@@ -1,5 +1,5 @@
 """Config dataclasses + YAML/JSON persistence (reference
-``commands/config/config_args.py:43-267``).
+``commands/config/config_args.py:43-244``).
 
 The reference stores a questionnaire result at
 ``~/.cache/huggingface/accelerate/default_config.yaml`` and merges it with

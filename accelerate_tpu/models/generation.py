@@ -1,7 +1,7 @@
 """Autoregressive generation: KV-cache decode loop + sampling.
 
 The reference's only *published* benchmark is token generation — s/token for
-big offloaded models (``/root/reference/benchmarks/big_model_inference.py:141-155``,
+big offloaded models (``/root/reference/benchmarks/big_model_inference.py:108-139``,
 ``benchmarks/README.md:27-37``) — delegated there to ``transformers``'
 ``model.generate`` over torch modules.  TPU-native generation is instead one
 compiled program:

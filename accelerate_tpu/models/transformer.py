@@ -253,7 +253,7 @@ class KVCache(struct.PyTreeNode):
     """Static-shape KV cache for autoregressive decode.
 
     The reference's published benchmark is token generation
-    (``/root/reference/benchmarks/big_model_inference.py:141-155``); its cache
+    (``/root/reference/benchmarks/big_model_inference.py:108-139``); its cache
     lives inside transformers' dynamic python objects.  TPU-first the cache is
     one pytree of fixed-shape arrays — ``[num_layers, batch, max_len, kv_heads,
     head_dim]`` — written in place with ``lax.dynamic_update_slice`` at a

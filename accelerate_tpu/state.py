@@ -75,7 +75,7 @@ class PartialState:
         self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
         if cpu:
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        # Opt-in NUMA pinning (reference utils/environment.py:286-291) — must
+        # Opt-in NUMA pinning (reference utils/environment.py:259-274) — must
         # run BEFORE any jax.* call below: sched_setaffinity only covers
         # threads created after it, and backend init spawns the PJRT
         # client/transfer thread pools that matter most.

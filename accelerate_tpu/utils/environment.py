@@ -2,7 +2,7 @@
 
 TPU-native analog of reference ``state.py:238-253`` (``OMP_NUM_THREADS``
 auto-set so host-side data workers don't oversubscribe cores) and reference
-``utils/environment.py:220-291`` (``set_numa_affinity``: pin a local process
+``utils/environment.py:220-274`` (``set_numa_affinity``: pin a local process
 to the cores of one NUMA node).  On a TPU host the hot host-side paths are the
 numpy/torch dataloader workers and the checkpoint/streaming IO threads — the
 same oversubscription and cross-socket-memory problems the reference tunes
@@ -113,7 +113,7 @@ def _warn_no_numa() -> None:
 def set_numa_affinity(local_process_index: int, verbose: bool = False) -> None:
     """Pin this process to one NUMA node's cores, round-robin by local rank.
 
-    Reference ``utils/environment.py:220-291`` pins to the NUMA node of the
+    Reference ``utils/environment.py:220-274`` pins to the NUMA node of the
     process's GPU (read from the PCIe topology).  A TPU host has no per-process
     accelerator locality to read — every local chip is driven by the one
     process — so for the CPU-debug gang (N local processes) we spread ranks
@@ -143,7 +143,7 @@ def set_numa_affinity(local_process_index: int, verbose: bool = False) -> None:
 
 def override_numa_affinity(local_process_index: int, verbose: Optional[bool] = None) -> None:
     """Apply NUMA pinning when ``ACCELERATE_USE_NUMA_AFFINITY`` is truthy
-    (reference ``utils/environment.py:286-291``)."""
+    (reference ``utils/environment.py:259-274``)."""
     from .dataclasses import parse_flag_from_env
 
     if parse_flag_from_env("ACCELERATE_USE_NUMA_AFFINITY"):
