@@ -38,11 +38,14 @@ from .big_modeling import (
 )
 from .launchers import debug_launcher, notebook_launcher
 from .models import (
+    BertConfig,
+    BertEncoder,
     GenerationConfig,
     KVCache,
     config_from_hf,
     convert_hf_checkpoint,
     generate,
+    load_hf_bert,
     load_hf_checkpoint,
     make_decode_step,
     make_prefill_step,

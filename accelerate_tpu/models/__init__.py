@@ -1,10 +1,13 @@
 """models subpackage."""
 
+from .bert import BertConfig, BertEncoder, load_hf_bert, masked_lm_logits
 from .generation import GenerationConfig, generate, make_decode_step, make_prefill_step, sample_tokens
 from .hf_compat import config_from_hf, convert_hf_checkpoint, load_hf_checkpoint, to_scan_layout
 from .transformer import KVCache, Transformer, TransformerConfig, cross_entropy_loss, lm_loss_fn
 
 __all__ = [
+    "BertConfig",
+    "BertEncoder",
     "GenerationConfig",
     "KVCache",
     "Transformer",
@@ -14,7 +17,9 @@ __all__ = [
     "cross_entropy_loss",
     "generate",
     "lm_loss_fn",
+    "load_hf_bert",
     "load_hf_checkpoint",
+    "masked_lm_logits",
     "make_decode_step",
     "make_prefill_step",
     "sample_tokens",

@@ -1,0 +1,248 @@
+"""BERT-family encoder: the bidirectional counterpart to models/transformer.py.
+
+The reference framework is model-agnostic but its canonical NLP example and
+test scripts all fine-tune ``bert-base-cased`` through ``AutoModel``
+(``/root/reference/examples/nlp_example.py:1-50``,
+``/root/reference/src/accelerate/test_utils/scripts/external_deps/test_performance.py:1-60``);
+this module gives the framework a real encoder to do the same with —
+architecture-exact BERT (post-LN blocks, token-type embeddings, erf-gelu,
+pooler, tied MLM head) plus the HF key mapping, so a downloaded
+``bert-base-*`` snapshot loads directly and reproduces torch logits
+(``tests/test_hf_compat.py::TestBertParity``).
+
+TPU-first choices mirror the decoder: static shapes, fp32 norm statistics
+(the shared ``transformer.LayerNorm``), padding handled by an additive
+attention bias (no dynamic shapes — the mask is data, not control flow),
+and the whole forward jit-compatible under mesh shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import LayerNorm as _LayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    # MLM-only exports (BertForMaskedLM uses add_pooling_layer=False) carry
+    # no pooler weights; load_hf_bert flips this off when they are absent
+    add_pooler: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], **overrides) -> "BertConfig":
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+        )
+        act = hf.get("hidden_act", "gelu")
+        if act != "gelu":
+            raise NotImplementedError(f"bert hidden_act {act!r} is not mapped")
+        fields.update(overrides)
+        return cls(**fields)
+
+
+class BertLayer(nn.Module):
+    """One post-LN encoder block: residual-then-norm on both sublayers
+    (BERT's original ordering, unlike the decoder's pre-LN blocks)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_bias):
+        cfg = self.config
+        d = cfg.hidden_size // cfg.num_heads
+        dense = lambda name, feat: nn.Dense(
+            feat, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        b, s, _ = x.shape
+        q = dense("query", cfg.hidden_size)(x).reshape(b, s, cfg.num_heads, d)
+        k = dense("key", cfg.hidden_size)(x).reshape(b, s, cfg.num_heads, d)
+        v = dense("value", cfg.hidden_size)(x).reshape(b, s, cfg.num_heads, d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
+        logits = logits + attn_bias  # [B, 1, 1, S] additive padding mask
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, cfg.hidden_size)
+        attn = dense("attn_out", cfg.hidden_size)(attn)
+        x = _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="attn_norm")(x + attn)
+        h = nn.gelu(dense("intermediate", cfg.intermediate_size)(x), approximate=False)
+        h = dense("output", cfg.hidden_size)(h)
+        return _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="out_norm")(x + h)
+
+
+class BertEncoder(nn.Module):
+    """``__call__(input_ids, attention_mask=None, token_type_ids=None)``
+    → ``(sequence_output [B,S,H], pooled_output [B,H])``."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.int32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), jnp.int32)
+        embed = lambda name, n: nn.Embed(
+            n, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        word = embed("word_embeddings", cfg.vocab_size)
+        x = (
+            word(input_ids)
+            + embed("position_embeddings", cfg.max_seq_len)(jnp.arange(s)[None, :])
+            + embed("token_type_embeddings", cfg.type_vocab_size)(token_type_ids)
+        )
+        x = _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="embed_norm")(x)
+        # additive mask: 0 keep / big-negative drop, broadcast over heads+query
+        attn_bias = (1.0 - attention_mask.astype(jnp.float32))[:, None, None, :] * -1e9
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layers_{i}")(x, attn_bias)
+        if not cfg.add_pooler:
+            return x, x[:, 0]
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="pooler")(x[:, 0])
+        )
+        return x, pooled
+
+
+def masked_lm_logits(encoder: BertEncoder, params: Dict[str, Any], input_ids,
+                     attention_mask=None, token_type_ids=None,
+                     mlm_params: Optional[Dict[str, Any]] = None):
+    """MLM logits from encoder params + the MLM head subtree.
+
+    ``mlm_params``: ``{"transform": {...dense...}, "transform_norm": {...},
+    "decoder_bias": [V]}`` — the transform stack plus output bias, with the
+    decoder weight tied to ``params["word_embeddings"]["embedding"]``.
+    """
+    cfg = encoder.config
+    x, _ = encoder.apply({"params": params}, input_ids, attention_mask, token_type_ids)
+    t = mlm_params["transform"]
+    x = x.astype(jnp.float32) @ t["kernel"].astype(jnp.float32) + t["bias"]
+    x = nn.gelu(x, approximate=False)
+    n = mlm_params["transform_norm"]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) * n["scale"] + n["bias"]
+    table = params["word_embeddings"]["embedding"].astype(jnp.float32)
+    return x @ table.T + mlm_params["decoder_bias"]
+
+
+# --------------------------------------------------------------- HF interop
+from .hf_compat import _ident, _t  # noqa: E402  (shared torch-layout transforms)
+
+
+def bert_key_map(cfg: BertConfig, prefix: str = "bert.") -> Dict[str, Tuple[str, Any]]:
+    """native key -> (hf key, transform).  ``prefix=""`` serves bare
+    ``BertModel`` exports (no ``bert.`` scope)."""
+    p = prefix
+    m: Dict[str, Tuple[str, Any]] = {
+        "word_embeddings.embedding": (f"{p}embeddings.word_embeddings.weight", _ident),
+        "position_embeddings.embedding": (f"{p}embeddings.position_embeddings.weight", _ident),
+        "token_type_embeddings.embedding": (f"{p}embeddings.token_type_embeddings.weight", _ident),
+        "embed_norm.scale": (f"{p}embeddings.LayerNorm.weight", _ident),
+        "embed_norm.bias": (f"{p}embeddings.LayerNorm.bias", _ident),
+    }
+    if cfg.add_pooler:
+        m["pooler.kernel"] = (f"{p}pooler.dense.weight", _t)
+        m["pooler.bias"] = (f"{p}pooler.dense.bias", _ident)
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"{p}encoder.layer.{i}"
+        pairs = [
+            (f"{n}.query", f"{h}.attention.self.query"),
+            (f"{n}.key", f"{h}.attention.self.key"),
+            (f"{n}.value", f"{h}.attention.self.value"),
+            (f"{n}.attn_out", f"{h}.attention.output.dense"),
+            (f"{n}.intermediate", f"{h}.intermediate.dense"),
+            (f"{n}.output", f"{h}.output.dense"),
+        ]
+        for native, hf in pairs:
+            m[f"{native}.kernel"] = (f"{hf}.weight", _t)
+            m[f"{native}.bias"] = (f"{hf}.bias", _ident)
+        m[f"{n}.attn_norm.scale"] = (f"{h}.attention.output.LayerNorm.weight", _ident)
+        m[f"{n}.attn_norm.bias"] = (f"{h}.attention.output.LayerNorm.bias", _ident)
+        m[f"{n}.out_norm.scale"] = (f"{h}.output.LayerNorm.weight", _ident)
+        m[f"{n}.out_norm.bias"] = (f"{h}.output.LayerNorm.bias", _ident)
+    return m
+
+
+_MLM_MAP = {
+    "transform.kernel": ("cls.predictions.transform.dense.weight", _t),
+    "transform.bias": ("cls.predictions.transform.dense.bias", _ident),
+    "transform_norm.scale": ("cls.predictions.transform.LayerNorm.weight", _ident),
+    "transform_norm.bias": ("cls.predictions.transform.LayerNorm.bias", _ident),
+    "decoder_bias": ("cls.predictions.bias", _ident),
+}
+
+
+def load_hf_bert(checkpoint: str, dtype=None, **config_overrides):
+    """HF ``bert-base-*`` snapshot dir → ``(encoder, params, mlm_params)``.
+
+    ``mlm_params`` is None when the checkpoint carries no MLM head (plain
+    ``BertModel`` exports).  Reads config.json + safetensors/torch-bin shards
+    through the same streaming readers as the decoder interop.
+    """
+    from ..utils.modeling import unflatten_tree
+    from .hf_compat import _iter_hf_tensors
+
+    with open(os.path.join(checkpoint, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if hf_cfg.get("model_type") != "bert":
+        raise ValueError(f"{checkpoint} is not a bert checkpoint")
+    # shard-index keys are enough to sniff the layout — no tensor loads yet
+    from ..big_modeling import _checkpoint_files
+
+    hf_keys = set(_checkpoint_files(checkpoint))
+    prefix = "bert." if any(k.startswith("bert.") for k in hf_keys) else ""
+    if f"{prefix}pooler.dense.weight" not in hf_keys:
+        config_overrides.setdefault("add_pooler", False)
+    cfg = BertConfig.from_hf(hf_cfg, **config_overrides)
+
+    by_hf = {hf_key: (native, transform)
+             for native, (hf_key, transform) in bert_key_map(cfg, prefix).items()}
+    has_mlm = "cls.predictions.transform.dense.weight" in hf_keys
+    if has_mlm:
+        by_hf.update({hf_key: (f"__mlm__.{native}", transform)
+                      for native, (hf_key, transform) in _MLM_MAP.items()})
+
+    # stream shard-at-a-time like the decoder interop: one tensor resident
+    flat: Dict[str, np.ndarray] = {}
+    for hf_key, tensor in _iter_hf_tensors(checkpoint):
+        target = by_hf.get(hf_key)
+        if target is None:  # position_ids buffers, tied-duplicate decoder, ...
+            continue
+        native, transform = target
+        t = transform(tensor)
+        flat[native] = t.astype(jnp.dtype(dtype)) if dtype is not None else t
+    missing = {n for n, _ in by_hf.values()} - set(flat)
+    if missing:
+        raise ValueError(f"{checkpoint} is missing tensors for {sorted(missing)[:5]}")
+
+    mlm_flat = {k[len("__mlm__."):]: v for k, v in flat.items() if k.startswith("__mlm__.")}
+    params = unflatten_tree({k: v for k, v in flat.items() if not k.startswith("__mlm__.")})
+    return BertEncoder(cfg), params, unflatten_tree(mlm_flat) if has_mlm else None

@@ -437,6 +437,68 @@ class TestGemmaParity:
         np.testing.assert_array_equal(np.asarray(out), tout.numpy())
 
 
+class TestBertParity:
+    """Encoder family: post-LN blocks, token-type embeddings, erf-gelu,
+    pooler, tied MLM head — vs torch BertModel / BertForMaskedLM."""
+
+    def _cfg(self):
+        return transformers.BertConfig(
+            vocab_size=128, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0,
+        )
+
+    def test_encoder_matches_torch(self, tmp_path):
+        """Bare BertModel export (no 'bert.' prefix): hidden states + pooler,
+        with a genuinely padded batch exercising the attention mask."""
+        from accelerate_tpu.models.bert import load_hf_bert
+
+        torch.manual_seed(13)
+        model = transformers.BertModel(self._cfg()).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        encoder, params, mlm = load_hf_bert(str(tmp_path))
+        assert mlm is None
+        rng = np.random.default_rng(13)
+        ids = rng.integers(0, 128, size=(2, 12)).astype(np.int64)
+        mask = np.ones_like(ids)
+        mask[1, 7:] = 0  # ragged second row
+        types = np.zeros_like(ids)
+        types[:, 6:] = 1
+        seq, pooled = encoder.apply(
+            {"params": params}, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(types)
+        )
+        with torch.no_grad():
+            out = model(
+                torch.from_numpy(ids), attention_mask=torch.from_numpy(mask),
+                token_type_ids=torch.from_numpy(types),
+            )
+        np.testing.assert_allclose(
+            np.asarray(seq)[np.asarray(mask, bool)],
+            out.last_hidden_state.numpy()[mask.astype(bool)],
+            rtol=3e-4, atol=3e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pooled), out.pooler_output.numpy(), rtol=3e-4, atol=3e-4
+        )
+
+    def test_mlm_logits_match_torch(self, tmp_path):
+        """BertForMaskedLM export ('bert.' prefix + cls head): tied-decoder
+        MLM logits."""
+        from accelerate_tpu.models.bert import load_hf_bert, masked_lm_logits
+
+        torch.manual_seed(14)
+        model = transformers.BertForMaskedLM(self._cfg()).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        encoder, params, mlm = load_hf_bert(str(tmp_path))
+        assert mlm is not None
+        ids = np.arange(3, 17, dtype=np.int64)[None, :]
+        ours = masked_lm_logits(encoder, params, jnp.asarray(ids), mlm_params=mlm)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(ids)).logits.float().numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
 class TestDispatchIntegration:
     def test_auto_detect_and_dispatch(self, tmp_path):
         """load_checkpoint_and_dispatch pointed at the RAW HF dir: detects,
