@@ -1009,7 +1009,7 @@ class StreamingTransformer(StreamingExecutor):
     ) -> np.ndarray:
         """Host-driven token loop over :meth:`forward_with_cache` — the
         reference's published benchmark workload (generation under CPU/disk
-        offload, ``benchmarks/big_model_inference.py:141-155``): every token
+        offload, ``benchmarks/big_model_inference.py:108-139``): every token
         streams the weights once, double-buffered against compute.
 
         Returns ``[B, S + max_new_tokens]`` numpy token ids (EOS lanes padded).

@@ -372,11 +372,15 @@ class FullyShardedDataParallelPlugin:
     # In-flight window for the chunked update: how many chunk programs may be
     # dispatched before blocking on the oldest.  2 (double-buffer) overlaps
     # chunk N's host write-back with chunk N+1's host read at peak HBM =
-    # overlap * chunk transients.  Default 1 (fully serialized): measured on
-    # a 16 GB v5e, the doubled transient footprint made the allocator thrash
-    # and the overlapped run came out 2x SLOWER than serialized
-    # (BENCH_NOTES.md round-4 zero3 rows) — raise it only with HBM headroom
-    # to spare.  Numerics are barrier-placement-invariant either way.
+    # overlap * chunk transients.  With the round-4 donation fixes in place,
+    # overlap=2 at an EXPLICIT ~1 GB chunk size measured 11% faster than
+    # serialized on the 2.13B/16 GB-v5e config (13.2 vs 14.9 s/step,
+    # BENCH_NOTES.md round-5 A/B; the same cell was 2x SLOWER pre-fix).
+    # The default stays 1 because adaptive sizing (chunk_mb=-1) divides the
+    # chunk budget by the window — halving every chunk — and the safe default
+    # must not trade step time for peak-memory risk on unknown rigs; set
+    # overlap=2 together with an explicit offload_update_chunk_mb to take the
+    # measured win.  Numerics are barrier-placement-invariant either way.
     offload_update_overlap: int = 1
     # Disk ("nvme") tier for the offloaded optimizer state: when set (and
     # offload_optimizer is on), the chunked update's source is mmap'd .dat

@@ -270,20 +270,24 @@ def bench_zero3(smoke: bool = False, batch: int = 4, chunk_mb: int = -1, overlap
 
     _bench_train_config(
         f"gpt2xl_zero3_offload{'_nvme' if offload_device == 'nvme' else ''}_samples_per_sec_per_chip",
-        dict(
-            vocab_size=50257,
-            hidden_size=1600,
-            intermediate_size=6400,
-            num_layers=48,
-            num_heads=25,
-            num_kv_heads=25,
-            max_seq_len=1024,
-            # full remat stays here: activation savings matter more than
-            # recompute FLOPs when the whole budget is params+grads+chunk
-            # streams, and step time is dominated by the optimizer-state
-            # stream anyway
+        {
+            # overrides may replace any default (e.g. a smaller geometry for
+            # the tunnel-bound nvme-tier proof run) — dict-merge, not
+            # keyword-collide.  Full remat stays the default: activation
+            # savings matter more than recompute FLOPs when the whole budget
+            # is params+grads+chunk streams, and step time is dominated by
+            # the optimizer-state stream anyway.
+            **dict(
+                vocab_size=50257,
+                hidden_size=1600,
+                intermediate_size=6400,
+                num_layers=48,
+                num_heads=25,
+                num_kv_heads=25,
+                max_seq_len=1024,
+            ),
             **cfg_overrides,
-        ),
+        },
         batch=batch,
         accelerator_kwargs=dict(
             deepspeed_plugin=at.ZeroPlugin(
@@ -293,10 +297,11 @@ def bench_zero3(smoke: bool = False, batch: int = 4, chunk_mb: int = -1, overlap
                 # adaptive chunk sizing from free HBM (utils/chunked_update.
                 # auto_chunk_bytes): resident working set + a 10% margin leave
                 # ~6 GB on a 16 GB chip for the in-flight window at ~4x
-                # transients per chunk.  overlap=1 (serialized) measured
-                # FASTER than the 2-deep double-buffer on this rig — the
-                # doubled transients thrash the allocator near the HBM limit
-                # (BENCH_NOTES.md round-4 zero3 rows).
+                # transients per chunk.  The round-5 A/B measured overlap=2
+                # 11% FASTER than serialized at an explicit 1 GB chunk size
+                # (post-donation-fix; BENCH_NOTES.md round-5) — pass
+                # --overlap 2 --chunk-mb 1024 to take it; the default stays
+                # serialized+adaptive for rigs without the headroom.
                 offload_update_chunk_mb=chunk_mb,
                 offload_update_overlap=overlap,
             ),

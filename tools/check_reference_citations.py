@@ -26,6 +26,10 @@ REF_SRC = os.path.join(REF_ROOT, "src", "accelerate")
 
 ABS = re.compile(r"/root/reference/[\w/.-]+?\.(?:py|md|json|yml|yaml)(?::\d+(?:-\d+)?)?")
 SHORT = re.compile(r"[Rr]eference(?:'s)?\s+`{0,2}([\w/.-]+\.py):(\d+)(?:-(\d+))?")
+# any other backticked path:line citation — self-citations into this repo or
+# bare reference cites without the "reference" prefix; resolved against both
+# trees (a citation is stale only when NO candidate file covers the lines)
+GENERIC = re.compile(r"`{1,2}([\w/.-]+\.py):(\d+)(?:-(\d+))?")
 
 
 def _file_lines(cache: dict, path: str) -> int | None:
@@ -52,20 +56,43 @@ def _basename_index() -> dict:
     return _BASENAMES
 
 
-def _resolve(cache: dict, relpath: str) -> int | None:
+def _resolve(cache: dict, relpath: str, include_repo: bool = False) -> int | None:
     """Line count of a shorthand-cited reference file.  Docstrings cite
     relative to ``src/accelerate/`` ("utils/dataclasses.py"), the repo root
     ("tests/test_multigpu.py", "benchmarks/..."), or by bare filename when the
     module mirrors its reference counterpart ("operations.py") — accept any
-    unambiguous resolution, largest line count when basenames collide."""
-    for base in (REF_SRC, REF_ROOT, os.path.join(REF_ROOT, "src")):
+    unambiguous resolution, largest line count when basenames collide.
+    ``include_repo`` additionally resolves against this repo's own tree (the
+    GENERIC self-citation form, e.g. ``models/transformer.py:208``)."""
+    bases = [REF_SRC, REF_ROOT, os.path.join(REF_ROOT, "src")]
+    if include_repo:
+        bases += [PKG, REPO, os.path.join(REPO, "accelerate_tpu")]
+    best = None
+    for base in bases:
         total = _file_lines(cache, os.path.join(base, relpath))
         if total is not None:
-            return total
-    candidates = _basename_index().get(os.path.basename(relpath), [])
+            best = max(best or 0, total)
+    if best is not None:
+        return best
+    candidates = list(_basename_index().get(os.path.basename(relpath), []))
+    if include_repo:
+        candidates += _repo_basename_index().get(os.path.basename(relpath), [])
     totals = [_file_lines(cache, c) for c in candidates]
     totals = [t for t in totals if t is not None]
     return max(totals) if totals else None
+
+
+_REPO_BASENAMES: dict = {}
+
+
+def _repo_basename_index() -> dict:
+    if not _REPO_BASENAMES:
+        for dirpath, dirnames, filenames in os.walk(REPO):
+            dirnames[:] = [d for d in dirnames if d not in (".git", "__pycache__")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    _REPO_BASENAMES.setdefault(fn, []).append(os.path.join(dirpath, fn))
+    return _REPO_BASENAMES
 
 
 def check() -> int:
@@ -83,8 +110,10 @@ def check() -> int:
             with open(src, encoding="utf-8") as f:
                 text = f.read()
             rel = os.path.relpath(src, REPO)
+            seen_spans = []
             for m in ABS.finditer(text):
                 n_citations += 1
+                seen_spans.append(m.span())
                 cited = m.group(0)
                 path, _, lines = cited.partition(":")
                 total = _file_lines(cache, path)
@@ -96,6 +125,7 @@ def check() -> int:
                     )
             for m in SHORT.finditer(text):
                 n_citations += 1
+                seen_spans.append(m.span())
                 relpath, lo, hi = m.group(1), m.group(2), m.group(3)
                 total = _resolve(cache, relpath)
                 if total is None:
@@ -104,6 +134,19 @@ def check() -> int:
                     problems.append(
                         f"{rel}: cited line {hi or lo} past EOF ({total} lines): "
                         f"reference {relpath}:{lo}{'-' + hi if hi else ''}"
+                    )
+            for m in GENERIC.finditer(text):
+                if any(a <= m.start() < b or a < m.end() <= b for a, b in seen_spans):
+                    continue  # already counted by ABS/SHORT
+                n_citations += 1
+                relpath, lo, hi = m.group(1), m.group(2), m.group(3)
+                total = _resolve(cache, relpath, include_repo=True)
+                if total is None:
+                    problems.append(f"{rel}: cited file missing: {relpath}")
+                elif int(hi or lo) > total:
+                    problems.append(
+                        f"{rel}: cited line {hi or lo} past EOF ({total} lines): "
+                        f"{relpath}:{lo}{'-' + hi if hi else ''}"
                     )
     for p in problems:
         print(f"STALE CITATION  {p}")
