@@ -46,6 +46,7 @@ __all__ = [
 # architectures with a key mapping; config.json "model_type" values
 SUPPORTED_MODEL_TYPES = (
     "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
+    "phi3", "falcon", "stablelm", "gpt_bigcode",
 )
 
 
@@ -94,24 +95,30 @@ def _llama_base_fields(
     )
 
 
+def _gpt2_base_fields(hf: Dict[str, Any]) -> Dict[str, Any]:
+    """The shared GPT-2-recipe config core (gpt2 and gpt_bigcode speak the
+    n_embd/n_layer/n_head spellings; family deltas layer on top)."""
+    return dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["n_embd"],
+        intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+        num_layers=hf["n_layer"],
+        num_heads=hf["n_head"],
+        num_kv_heads=hf["n_head"],
+        max_seq_len=hf.get("n_positions", 1024),
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        norm_type="layernorm",
+        use_bias=True,
+        positional="learned",
+        mlp_variant="gelu",
+    )
+
+
 def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
     model_type = hf.get("model_type")
     if model_type == "gpt2":
-        fields = dict(
-            vocab_size=hf["vocab_size"],
-            hidden_size=hf["n_embd"],
-            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
-            num_layers=hf["n_layer"],
-            num_heads=hf["n_head"],
-            num_kv_heads=hf["n_head"],
-            max_seq_len=hf.get("n_positions", 1024),
-            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
-            tie_word_embeddings=hf.get("tie_word_embeddings", True),
-            norm_type="layernorm",
-            use_bias=True,
-            positional="learned",
-            mlp_variant="gelu",
-        )
+        fields = _gpt2_base_fields(hf)
         if hf.get("activation_function", "gelu_new") not in ("gelu_new", "gelu_pytorch_tanh"):
             raise NotImplementedError(
                 f"GPT-2 activation {hf['activation_function']!r} is not mapped "
@@ -256,6 +263,99 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
         )
         if hf.get("attention_bias", False):
             fields["attn_bias"] = True
+    elif model_type == "phi3":
+        # Llama recipe with FUSED projections (qkv_proj / gate_up_proj —
+        # split in the key map) and an optional sliding window
+        if hf.get("rope_scaling"):
+            raise NotImplementedError(
+                "phi3 rope_scaling (longrope) is not mapped; only the base "
+                "rope models load"
+            )
+        fields = _llama_base_fields(hf)
+        fields["sliding_window"] = hf.get("sliding_window")
+    elif model_type == "stablelm":
+        # Llama recipe with LayerNorm(+bias) norms, partial rotary, and
+        # optional q/k/v biases
+        if hf.get("use_parallel_residual", False):
+            raise NotImplementedError(
+                "stablelm use_parallel_residual=true is not mapped "
+                "(sequential-residual checkpoints only)"
+            )
+        if hf.get("qk_layernorm", False):
+            raise NotImplementedError("stablelm qk_layernorm=true is not mapped")
+        if hf.get("rope_scaling"):
+            raise NotImplementedError("stablelm rope_scaling is not mapped")
+        fields = _llama_base_fields(hf)
+        head_dim = fields["hidden_size"] // fields["num_heads"]
+        fields.update(
+            norm_type="layernorm",
+            rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+            rope_dim=int(hf.get("partial_rotary_factor", 0.25) * head_dim),
+            qkv_bias=bool(hf.get("use_qkv_bias", False)),
+        )
+    elif model_type == "falcon":
+        # Parallel-residual decoder, LayerNorm(+bias), non-gated erf-gelu
+        # MLP, fused grouped qkv.  7B style: multi-query + ONE shared norm;
+        # 40B/180B style (new_decoder_architecture): GQA + ln_attn/ln_mlp.
+        if hf.get("alibi", False):
+            raise NotImplementedError(
+                "falcon alibi position encoding is not mapped (rope models only)"
+            )
+        if hf.get("bias", False):
+            raise NotImplementedError("falcon bias=true projections are not mapped")
+        if not hf.get("parallel_attn", True):
+            raise NotImplementedError("falcon parallel_attn=false is not mapped")
+        if hf.get("rope_scaling"):
+            raise NotImplementedError("falcon rope_scaling is not mapped")
+        act = hf.get("activation", "gelu")
+        if act != "gelu":  # FalconMLP: ACT2FN[activation], "gelu" = erf form
+            raise NotImplementedError(f"falcon activation {act!r} is not mapped")
+        new_arch = hf.get("new_decoder_architecture", False)
+        heads = hf["num_attention_heads"]
+        if new_arch:
+            kv = hf.get("num_kv_heads") or heads
+        elif hf.get("multi_query", True):
+            kv = 1
+        else:
+            raise NotImplementedError(
+                "legacy falcon per-head-interleaved qkv (multi_query=false, "
+                "new_decoder_architecture=false) is not mapped"
+            )
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=kv,
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            norm_type="layernorm",
+            mlp_variant="gelu_exact",
+            parallel_residual=True,
+            shared_norm=not new_arch,
+        )
+    elif model_type == "gpt_bigcode":
+        # StarCoder family: GPT-2 recipe (learned positions, LayerNorm+bias,
+        # tanh-gelu, tied embeddings) but torch Linear layouts and multi-query
+        # attention with a fused c_attn
+        act = hf.get("activation_function", "gelu_pytorch_tanh")
+        if act not in ("gelu_pytorch_tanh", "gelu_new"):
+            raise NotImplementedError(f"gpt_bigcode activation {act!r} is not mapped")
+        if not hf.get("multi_query", True):
+            # the MHA ablations store c_attn head-major interleaved
+            # ([q,k,v] per head), a different layout than the MQ [q|k|v]
+            # block split bigcode_key_map implements
+            raise NotImplementedError(
+                "gpt_bigcode multi_query=false (head-interleaved c_attn) is "
+                "not mapped"
+            )
+        fields = dict(
+            _gpt2_base_fields(hf),
+            num_kv_heads=1,  # multi-query
+        )
     else:
         raise NotImplementedError(
             f"model_type {model_type!r} has no key mapping; supported: "
@@ -463,6 +563,9 @@ def llama_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
         "embed_tokens.embedding": ("model.embed_tokens.weight", _ident),
         "final_norm.scale": ("model.norm.weight", _ident),
     }
+    norm_bias = cfg.norm_type == "layernorm"  # StableLM: LayerNorm with bias
+    if norm_bias:
+        m["final_norm.bias"] = ("model.norm.bias", _ident)
     if not cfg.tie_word_embeddings:
         m["lm_head.kernel"] = ("lm_head.weight", _t)
     attn_b = cfg.attn_bias if cfg.attn_bias is not None else cfg.use_bias
@@ -474,6 +577,9 @@ def llama_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
             f"{n}.input_norm.scale": (f"{h}.input_layernorm.weight", _ident),
             f"{n}.post_attn_norm.scale": (f"{h}.post_attention_layernorm.weight", _ident),
         })
+        if norm_bias:
+            m[f"{n}.input_norm.bias"] = (f"{h}.input_layernorm.bias", _ident)
+            m[f"{n}.post_attn_norm.bias"] = (f"{h}.post_attention_layernorm.bias", _ident)
         for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
             m[f"{n}.attn.{proj}.kernel"] = (f"{h}.self_attn.{proj}.weight", _t)
             if (qkv_b if proj != "o_proj" else attn_b):
@@ -482,6 +588,151 @@ def llama_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
             m[f"{n}.mlp.{proj}.kernel"] = (f"{h}.mlp.{proj}.weight", _t)
             if mlp_b:
                 m[f"{n}.mlp.{proj}.bias"] = (f"{h}.mlp.{proj}.bias", _ident)
+    return m
+
+
+def _rows(lo: int, hi: int) -> Callable:
+    """Transform slicing rows [lo:hi) of a fused torch tensor: 2-D weights
+    transpose to flax [in, out_slice]; 1-D biases just slice."""
+
+    def f(x: np.ndarray) -> np.ndarray:
+        part = x[lo:hi]
+        return _t(part) if part.ndim == 2 else np.ascontiguousarray(part)
+
+    return f
+
+
+def phi3_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """Phi-3 naming: Llama tree with FUSED ``qkv_proj`` (q|k|v rows) and
+    ``gate_up_proj`` (gate|up rows) — multiple native keys read row slices
+    of one HF tensor (the converter fans one tensor out to many targets,
+    as with GPT-2's Conv1D qkv)."""
+    hd = cfg.resolved_head_dim
+    q_rows, kv_rows = cfg.num_heads * hd, cfg.num_kv_heads * hd
+    inter = cfg.intermediate_size
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("model.embed_tokens.weight", _ident),
+        "final_norm.scale": ("model.norm.weight", _ident),
+    }
+    if not cfg.tie_word_embeddings:
+        m["lm_head.kernel"] = ("lm_head.weight", _t)
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"model.layers.{i}"
+        qkv = f"{h}.self_attn.qkv_proj.weight"
+        gu = f"{h}.mlp.gate_up_proj.weight"
+        m.update({
+            f"{n}.input_norm.scale": (f"{h}.input_layernorm.weight", _ident),
+            f"{n}.post_attn_norm.scale": (f"{h}.post_attention_layernorm.weight", _ident),
+            f"{n}.attn.q_proj.kernel": (qkv, _rows(0, q_rows)),
+            f"{n}.attn.k_proj.kernel": (qkv, _rows(q_rows, q_rows + kv_rows)),
+            f"{n}.attn.v_proj.kernel": (qkv, _rows(q_rows + kv_rows, q_rows + 2 * kv_rows)),
+            f"{n}.attn.o_proj.kernel": (f"{h}.self_attn.o_proj.weight", _t),
+            f"{n}.mlp.gate_proj.kernel": (gu, _rows(0, inter)),
+            f"{n}.mlp.up_proj.kernel": (gu, _rows(inter, 2 * inter)),
+            f"{n}.mlp.down_proj.kernel": (f"{h}.mlp.down_proj.weight", _t),
+        })
+    return m
+
+
+def _falcon_grouped_split(cfg: TransformerConfig, which: str) -> Callable:
+    """new_decoder_architecture fused qkv: rows are grouped per KV head as
+    [q_0..q_{g-1}, k, v] x num_kv_heads (g = query heads per group)."""
+    hd = cfg.resolved_head_dim
+    groups = cfg.num_kv_heads
+    per_group = cfg.num_heads // groups
+
+    def f(x: np.ndarray) -> np.ndarray:
+        hidden = x.shape[-1]
+        g = x.reshape(groups, per_group + 2, hd, hidden)
+        if which == "q":
+            part = g[:, :per_group].reshape(groups * per_group * hd, hidden)
+        elif which == "k":
+            part = g[:, -2].reshape(groups * hd, hidden)
+        else:
+            part = g[:, -1].reshape(groups * hd, hidden)
+        return _t(part)
+
+    return f
+
+
+def falcon_key_map(cfg: TransformerConfig, new_arch: bool) -> Dict[str, Tuple[str, Callable]]:
+    """Falcon naming (``transformer.h.{i}.self_attention...``).  7B style
+    (``new_arch=False``): multi-query rows [q|k|v], one shared norm.  40B
+    style: grouped qkv (:func:`_falcon_grouped_split`), ln_attn + ln_mlp."""
+    hd = cfg.resolved_head_dim
+    q_rows = cfg.num_heads * hd
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("transformer.word_embeddings.weight", _ident),
+        "final_norm.scale": ("transformer.ln_f.weight", _ident),
+        "final_norm.bias": ("transformer.ln_f.bias", _ident),
+    }
+    if not cfg.tie_word_embeddings:
+        m["lm_head.kernel"] = ("lm_head.weight", _t)
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"transformer.h.{i}"
+        qkv = f"{h}.self_attention.query_key_value.weight"
+        if new_arch:
+            m.update({
+                f"{n}.input_norm.scale": (f"{h}.ln_attn.weight", _ident),
+                f"{n}.input_norm.bias": (f"{h}.ln_attn.bias", _ident),
+                f"{n}.post_attn_norm.scale": (f"{h}.ln_mlp.weight", _ident),
+                f"{n}.post_attn_norm.bias": (f"{h}.ln_mlp.bias", _ident),
+                f"{n}.attn.q_proj.kernel": (qkv, _falcon_grouped_split(cfg, "q")),
+                f"{n}.attn.k_proj.kernel": (qkv, _falcon_grouped_split(cfg, "k")),
+                f"{n}.attn.v_proj.kernel": (qkv, _falcon_grouped_split(cfg, "v")),
+            })
+        else:
+            kv_rows = cfg.num_kv_heads * hd  # multi-query: one kv head
+            m.update({
+                f"{n}.input_norm.scale": (f"{h}.input_layernorm.weight", _ident),
+                f"{n}.input_norm.bias": (f"{h}.input_layernorm.bias", _ident),
+                f"{n}.attn.q_proj.kernel": (qkv, _rows(0, q_rows)),
+                f"{n}.attn.k_proj.kernel": (qkv, _rows(q_rows, q_rows + kv_rows)),
+                f"{n}.attn.v_proj.kernel": (qkv, _rows(q_rows + kv_rows, q_rows + 2 * kv_rows)),
+            })
+        m.update({
+            f"{n}.attn.o_proj.kernel": (f"{h}.self_attention.dense.weight", _t),
+            f"{n}.mlp.up_proj.kernel": (f"{h}.mlp.dense_h_to_4h.weight", _t),
+            f"{n}.mlp.down_proj.kernel": (f"{h}.mlp.dense_4h_to_h.weight", _t),
+        })
+    return m
+
+
+def bigcode_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """GPT-BigCode / StarCoder naming (``transformer.h.{i}.attn.c_attn``):
+    GPT-2's tree shape but torch Linear layouts (transpose, unlike Conv1D)
+    and a multi-query fused c_attn [q | k | v] with biases throughout."""
+    hd = cfg.resolved_head_dim
+    q_rows, kv_rows = cfg.num_heads * hd, cfg.num_kv_heads * hd
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("transformer.wte.weight", _ident),
+        "pos_embed.embedding": ("transformer.wpe.weight", _ident),
+        "final_norm.scale": ("transformer.ln_f.weight", _ident),
+        "final_norm.bias": ("transformer.ln_f.bias", _ident),
+    }
+    if not cfg.tie_word_embeddings:
+        m["lm_head.kernel"] = ("lm_head.weight", _t)
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"transformer.h.{i}"
+        m.update({
+            f"{n}.input_norm.scale": (f"{h}.ln_1.weight", _ident),
+            f"{n}.input_norm.bias": (f"{h}.ln_1.bias", _ident),
+            f"{n}.post_attn_norm.scale": (f"{h}.ln_2.weight", _ident),
+            f"{n}.post_attn_norm.bias": (f"{h}.ln_2.bias", _ident),
+        })
+        for proj, lo, hi in (("q_proj", 0, q_rows),
+                             ("k_proj", q_rows, q_rows + kv_rows),
+                             ("v_proj", q_rows + kv_rows, q_rows + 2 * kv_rows)):
+            m[f"{n}.attn.{proj}.kernel"] = (f"{h}.attn.c_attn.weight", _rows(lo, hi))
+            m[f"{n}.attn.{proj}.bias"] = (f"{h}.attn.c_attn.bias", _rows(lo, hi))
+        m.update({
+            f"{n}.attn.o_proj.kernel": (f"{h}.attn.c_proj.weight", _t),
+            f"{n}.attn.o_proj.bias": (f"{h}.attn.c_proj.bias", _ident),
+            f"{n}.mlp.up_proj.kernel": (f"{h}.mlp.c_fc.weight", _t),
+            f"{n}.mlp.up_proj.bias": (f"{h}.mlp.c_fc.bias", _ident),
+            f"{n}.mlp.down_proj.kernel": (f"{h}.mlp.c_proj.weight", _t),
+            f"{n}.mlp.down_proj.bias": (f"{h}.mlp.c_proj.bias", _ident),
+        })
     return m
 
 
@@ -499,7 +750,13 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = gptj_key_map(cfg)
     elif hf["model_type"] == "gpt_neox":
         mapping = gpt_neox_key_map(cfg)
-    else:
+    elif hf["model_type"] == "phi3":
+        mapping = phi3_key_map(cfg)
+    elif hf["model_type"] == "falcon":
+        mapping = falcon_key_map(cfg, hf.get("new_decoder_architecture", False))
+    elif hf["model_type"] == "gpt_bigcode":
+        mapping = bigcode_key_map(cfg)
+    else:  # llama recipe: llama / mistral / qwen2 / gemma / stablelm
         mapping = llama_key_map(cfg)
     return cfg, mapping
 

@@ -437,6 +437,172 @@ class TestGemmaParity:
         np.testing.assert_array_equal(np.asarray(out), tout.numpy())
 
 
+class TestPhi3Parity:
+    """Phi-3 family: Llama recipe with fused qkv_proj / gate_up_proj rows
+    split by the key map."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, pad_token_id=0,
+        )
+        torch.manual_seed(15)
+        model = transformers.Phi3ForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        rng = np.random.default_rng(15)
+        ids = rng.integers(1, 128, size=(2, 15)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_rope_scaling_rejected(self, tmp_path):
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        hf = dict(model_type="phi3", vocab_size=128, hidden_size=64,
+                  intermediate_size=160, num_hidden_layers=2,
+                  num_attention_heads=4, rope_scaling={"type": "longrope"})
+        with pytest.raises(NotImplementedError, match="longrope"):
+            _config_from_hf_dict(hf)
+
+
+class TestFalconParity:
+    """Falcon family, both generations: 7B style (multi-query fused qkv, one
+    shared norm, parallel residual) and 40B/180B style
+    (new_decoder_architecture: grouped qkv, ln_attn + ln_mlp)."""
+
+    def _save_tiny(self, tmp_path, new_arch):
+        kw = dict(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, bias=False, alibi=False, parallel_attn=True,
+            pad_token_id=0, attention_dropout=0.0, hidden_dropout=0.0,
+        )
+        if new_arch:
+            kw.update(new_decoder_architecture=True, multi_query=False, num_kv_heads=2)
+        else:
+            kw.update(new_decoder_architecture=False, multi_query=True)
+        cfg = transformers.FalconConfig(**kw)
+        torch.manual_seed(16)
+        model = transformers.FalconForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_7b_style_logits(self, tmp_path):
+        model = self._save_tiny(tmp_path, new_arch=False)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.num_kv_heads == 1 and cfg.parallel_residual and cfg.shared_norm
+        assert cfg.norm_type == "layernorm" and cfg.mlp_variant == "gelu_exact"
+        rng = np.random.default_rng(16)
+        ids = rng.integers(0, 128, size=(2, 14)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_40b_style_logits(self, tmp_path):
+        """Grouped fused qkv ([q..q k v] per KV group) + separate ln_attn/ln_mlp."""
+        model = self._save_tiny(tmp_path, new_arch=True)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.num_kv_heads == 2 and not cfg.shared_norm
+        ids = np.arange(2, 18, dtype=np.int64)[None, :]
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_alibi_rejected(self, tmp_path):
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        with pytest.raises(NotImplementedError, match="alibi"):
+            _config_from_hf_dict(dict(model_type="falcon", vocab_size=128,
+                                      hidden_size=64, num_hidden_layers=2,
+                                      num_attention_heads=4, alibi=True))
+
+
+class TestStableLMParity:
+    """StableLM family: Llama tree with LayerNorm(+bias) norms, partial
+    rotary (rotate-half), optional q/k/v biases."""
+
+    def _save_tiny(self, tmp_path, qkv_bias=False):
+        cfg = transformers.StableLmConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, use_qkv_bias=qkv_bias, pad_token_id=0,
+            attention_dropout=0.0, hidden_dropout=0.0,
+        )
+        torch.manual_seed(17)
+        model = transformers.StableLmForCausalLM(cfg).eval()
+        if qkv_bias:
+            with torch.no_grad():
+                for layer in model.model.layers:
+                    for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                                 layer.self_attn.v_proj):
+                        proj.bias.normal_(std=0.05)
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.norm_type == "layernorm" and cfg.rope_dim == 4  # 0.25 * 16
+        rng = np.random.default_rng(17)
+        ids = rng.integers(0, 128, size=(2, 13)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_qkv_bias_variant(self, tmp_path):
+        model = self._save_tiny(tmp_path, qkv_bias=True)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.qkv_bias is True
+        ids = np.arange(9, dtype=np.int64)[None, :]
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+
+class TestBigCodeParity:
+    """GPT-BigCode / StarCoder: GPT-2 recipe with torch Linear layouts and
+    multi-query fused c_attn ([q|k|v] rows, biases throughout)."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            pad_token_id=0, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+        torch.manual_seed(18)
+        model = transformers.GPTBigCodeForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.num_kv_heads == 1 and cfg.positional == "learned"
+        assert cfg.tie_word_embeddings and cfg.use_bias
+        rng = np.random.default_rng(18)
+        ids = rng.integers(0, 128, size=(2, 16)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_unmapped_variants_rejected(self):
+        """Silent-wrong-weights configs fail loudly: MHA bigcode (interleaved
+        c_attn), falcon non-gelu activation, falcon/stablelm rope_scaling."""
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        base = dict(vocab_size=128, n_embd=64, n_layer=2, n_head=4)
+        with pytest.raises(NotImplementedError, match="multi_query"):
+            _config_from_hf_dict(dict(model_type="gpt_bigcode", multi_query=False, **base))
+        falcon = dict(model_type="falcon", vocab_size=128, hidden_size=64,
+                      num_hidden_layers=2, num_attention_heads=4)
+        with pytest.raises(NotImplementedError, match="activation"):
+            _config_from_hf_dict(dict(falcon, activation="relu"))
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            _config_from_hf_dict(dict(falcon, rope_scaling={"type": "linear", "factor": 2}))
+        stablelm = dict(model_type="stablelm", vocab_size=128, hidden_size=64,
+                        intermediate_size=160, num_hidden_layers=2,
+                        num_attention_heads=4)
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            _config_from_hf_dict(dict(stablelm, rope_scaling={"type": "linear", "factor": 2}))
+
+
 class TestBertParity:
     """Encoder family: post-LN blocks, token-type embeddings, erf-gelu,
     pooler, tied MLM head — vs torch BertModel / BertForMaskedLM."""
