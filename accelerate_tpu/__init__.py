@@ -40,6 +40,8 @@ from .launchers import debug_launcher, notebook_launcher
 from .models import (
     BertConfig,
     BertEncoder,
+    T5,
+    T5Config,
     GenerationConfig,
     KVCache,
     config_from_hf,
@@ -47,6 +49,7 @@ from .models import (
     generate,
     load_hf_bert,
     load_hf_checkpoint,
+    load_hf_t5,
     make_decode_step,
     make_prefill_step,
     sample_tokens,

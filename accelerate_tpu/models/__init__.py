@@ -1,6 +1,7 @@
 """models subpackage."""
 
 from .bert import BertConfig, BertEncoder, load_hf_bert, masked_lm_logits
+from .t5 import T5, T5Config, load_hf_t5
 from .generation import GenerationConfig, generate, make_decode_step, make_prefill_step, sample_tokens
 from .hf_compat import config_from_hf, convert_hf_checkpoint, load_hf_checkpoint, to_scan_layout
 from .transformer import KVCache, Transformer, TransformerConfig, cross_entropy_loss, lm_loss_fn
@@ -8,6 +9,8 @@ from .transformer import KVCache, Transformer, TransformerConfig, cross_entropy_
 __all__ = [
     "BertConfig",
     "BertEncoder",
+    "T5",
+    "T5Config",
     "GenerationConfig",
     "KVCache",
     "Transformer",
@@ -19,6 +22,7 @@ __all__ = [
     "lm_loss_fn",
     "load_hf_bert",
     "load_hf_checkpoint",
+    "load_hf_t5",
     "masked_lm_logits",
     "make_decode_step",
     "make_prefill_step",

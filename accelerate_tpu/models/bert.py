@@ -208,7 +208,6 @@ def load_hf_bert(checkpoint: str, dtype=None, **config_overrides):
     through the same streaming readers as the decoder interop.
     """
     from ..utils.modeling import unflatten_tree
-    from .hf_compat import _iter_hf_tensors
 
     with open(os.path.join(checkpoint, "config.json")) as f:
         hf_cfg = json.load(f)
@@ -216,6 +215,7 @@ def load_hf_bert(checkpoint: str, dtype=None, **config_overrides):
         raise ValueError(f"{checkpoint} is not a bert checkpoint")
     # shard-index keys are enough to sniff the layout — no tensor loads yet
     from ..big_modeling import _checkpoint_files
+    from .hf_compat import stream_mapped_tensors
 
     hf_keys = set(_checkpoint_files(checkpoint))
     prefix = "bert." if any(k.startswith("bert.") for k in hf_keys) else ""
@@ -223,26 +223,11 @@ def load_hf_bert(checkpoint: str, dtype=None, **config_overrides):
         config_overrides.setdefault("add_pooler", False)
     cfg = BertConfig.from_hf(hf_cfg, **config_overrides)
 
-    by_hf = {hf_key: (native, transform)
-             for native, (hf_key, transform) in bert_key_map(cfg, prefix).items()}
+    mapping = bert_key_map(cfg, prefix)
     has_mlm = "cls.predictions.transform.dense.weight" in hf_keys
     if has_mlm:
-        by_hf.update({hf_key: (f"__mlm__.{native}", transform)
-                      for native, (hf_key, transform) in _MLM_MAP.items()})
-
-    # stream shard-at-a-time like the decoder interop: one tensor resident
-    flat: Dict[str, np.ndarray] = {}
-    for hf_key, tensor in _iter_hf_tensors(checkpoint):
-        target = by_hf.get(hf_key)
-        if target is None:  # position_ids buffers, tied-duplicate decoder, ...
-            continue
-        native, transform = target
-        t = transform(tensor)
-        flat[native] = t.astype(jnp.dtype(dtype)) if dtype is not None else t
-    missing = {n for n, _ in by_hf.values()} - set(flat)
-    if missing:
-        raise ValueError(f"{checkpoint} is missing tensors for {sorted(missing)[:5]}")
-
+        mapping.update({f"__mlm__.{native}": spec for native, spec in _MLM_MAP.items()})
+    flat = stream_mapped_tensors(checkpoint, mapping, dtype=dtype)
     mlm_flat = {k[len("__mlm__."):]: v for k, v in flat.items() if k.startswith("__mlm__.")}
     params = unflatten_tree({k: v for k, v in flat.items() if not k.startswith("__mlm__.")})
     return BertEncoder(cfg), params, unflatten_tree(mlm_flat) if has_mlm else None

@@ -784,6 +784,36 @@ def _iter_hf_tensors(checkpoint: str) -> Iterator[Tuple[str, np.ndarray]]:
                     yield k, f.get_tensor(k)
 
 
+def stream_mapped_tensors(checkpoint: str, mapping: Dict[str, Tuple[str, Callable]],
+                          dtype=None) -> Dict[str, np.ndarray]:
+    """Stream a checkpoint through a ``{native: (hf_key, transform)}`` map,
+    one tensor resident at a time → flat ``{native: array}``.
+
+    The shared loader core behind :func:`~.bert.load_hf_bert` and
+    :func:`~.t5.load_hf_t5` (``convert_hf_checkpoint`` keeps its own loop —
+    it additionally shards to disk and fans one HF tensor out to several
+    natives).  Unmapped HF keys (tied duplicates, buffer caches) are
+    skipped; missing mapped tensors raise.
+    """
+    import jax.numpy as jnp
+
+    by_hf: Dict[str, Tuple[str, Callable]] = {
+        hf_key: (native, transform) for native, (hf_key, transform) in mapping.items()
+    }
+    flat: Dict[str, np.ndarray] = {}
+    for hf_key, tensor in _iter_hf_tensors(checkpoint):
+        target = by_hf.get(hf_key)
+        if target is None:
+            continue
+        native, transform = target
+        t = transform(tensor)
+        flat[native] = t.astype(jnp.dtype(dtype)) if dtype is not None else t
+    missing = set(mapping) - set(flat)
+    if missing:
+        raise ValueError(f"{checkpoint} is missing tensors for {sorted(missing)[:5]}")
+    return flat
+
+
 def convert_hf_checkpoint(
     checkpoint: str,
     out_dir: Optional[str] = None,

@@ -1,0 +1,313 @@
+"""T5-family encoder-decoder: the seq2seq counterpart to the decoder flagship.
+
+The reference framework's seq2seq examples fine-tune T5 through ``AutoModel``
+(``/root/reference/examples/by_feature/checkpointing.py:1-40`` uses the same
+Accelerator surface for any HF model class); this module provides the
+encoder-decoder architecture natively — pre-LN RMSNorm stacks, bucketed
+relative-position-bias attention (NO rope/learned positions and NO
+1/sqrt(d) score scaling, T5's signature choices), decoder cross-attention,
+relu (v1.0) or gated-gelu (v1.1) FFN, tied-and-scaled or untied LM head —
+plus the HF key mapping, so a ``t5-*`` / ``flan-t5-*`` snapshot loads and
+reproduces torch logits (``tests/test_hf_compat.py::TestT5Parity``).
+
+TPU-first: static shapes, fp32 softmax/norm statistics, the relative-bias
+bucketing is a closed-form gather (no data-dependent control flow), and the
+whole encoder+decoder forward jits as one program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64                  # per-head dim (NOT necessarily d_model/heads)
+    d_ff: int = 2048
+    num_layers: int = 6             # encoder depth
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True   # v1.0 ties and scales the head
+    gated_ff: bool = False             # v1.1 "gated-gelu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], **overrides) -> "T5Config":
+        ff = hf.get("feed_forward_proj", "relu")
+        if ff not in ("relu", "gated-gelu"):
+            raise NotImplementedError(f"t5 feed_forward_proj {ff!r} is not mapped")
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["d_model"],
+            d_kv=hf["d_kv"],
+            d_ff=hf["d_ff"],
+            num_layers=hf["num_layers"],
+            num_decoder_layers=hf.get("num_decoder_layers", hf["num_layers"]),
+            num_heads=hf["num_heads"],
+            relative_attention_num_buckets=hf.get("relative_attention_num_buckets", 32),
+            relative_attention_max_distance=hf.get("relative_attention_max_distance", 128),
+            layer_norm_eps=hf.get("layer_norm_epsilon", 1e-6),
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            gated_ff=ff == "gated-gelu",
+        )
+        fields.update(overrides)
+        return cls(**fields)
+
+
+def _relative_position_bucket(relative_position, bidirectional: bool,
+                              num_buckets: int, max_distance: int):
+    """T5's log-bucketed relative positions (closed-form; matches HF
+    ``T5Attention._relative_position_bucket`` exactly)."""
+    ret = jnp.zeros_like(relative_position)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (relative_position > 0).astype(jnp.int32) * num_buckets
+        rel = jnp.abs(relative_position)
+    else:
+        rel = -jnp.minimum(relative_position, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    rel_f = jnp.maximum(rel.astype(jnp.float32), 1.0)
+    large = max_exact + (
+        jnp.log(rel_f / max_exact) / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, rel, large)
+
+
+class T5RelativeBias(nn.Module):
+    """[1, heads, q_len, k_len] additive bias from the bucketed relative
+    positions — present only in each stack's first block (HF shares block
+    0's table with the rest of the stack)."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_len: int, k_len: int):
+        cfg = self.config
+        table = self.param(
+            "embedding", nn.initializers.normal(0.02),
+            (cfg.relative_attention_num_buckets, cfg.num_heads), cfg.param_dtype,
+        )
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        buckets = _relative_position_bucket(
+            mem - ctx, self.bidirectional,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        return jnp.transpose(table[buckets], (2, 0, 1))[None]  # [1, H, Q, K]
+
+
+class T5Attention(nn.Module):
+    """T5 attention: UNscaled scores + additive position bias; q/k/v/o
+    project to ``num_heads * d_kv`` without biases."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, kv, bias):
+        cfg = self.config
+        inner = cfg.num_heads * cfg.d_kv
+        dense = lambda name: nn.Dense(
+            inner if name != "o_proj" else cfg.d_model, use_bias=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name,
+        )
+        b, q_len, _ = x.shape
+        k_len = kv.shape[1]
+        q = dense("q_proj")(x).reshape(b, q_len, cfg.num_heads, cfg.d_kv)
+        k = dense("k_proj")(kv).reshape(b, k_len, cfg.num_heads, cfg.d_kv)
+        v = dense("v_proj")(kv).reshape(b, k_len, cfg.num_heads, cfg.d_kv)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)  # no 1/sqrt(d)
+        logits = logits + bias
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, inner)
+        return dense("o_proj")(out)
+
+
+class T5FF(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda name, feat: nn.Dense(
+            feat, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        if cfg.gated_ff:  # v1.1: gelu(wi_0(x)) * wi_1(x)
+            h = nn.gelu(dense("wi_0", cfg.d_ff)(x), approximate=True) * dense("wi_1", cfg.d_ff)(x)
+        else:
+            h = nn.relu(dense("wi", cfg.d_ff)(x))
+        return dense("wo", cfg.d_model)(h)
+
+
+def _norm(cfg: T5Config, name: str):
+    return RMSNorm(cfg.layer_norm_eps, cfg.param_dtype, name=name)
+
+
+class T5Block(nn.Module):
+    config: T5Config
+    has_cross: bool
+
+    @nn.compact
+    def __call__(self, x, self_bias, enc_out=None, cross_bias=None):
+        cfg = self.config
+        normed = _norm(cfg, "self_norm")(x)
+        x = x + T5Attention(cfg, name="self_attn")(normed, normed, self_bias)
+        if self.has_cross:
+            normed = _norm(cfg, "cross_norm")(x)
+            x = x + T5Attention(cfg, name="cross_attn")(normed, enc_out, cross_bias)
+        x = x + T5FF(cfg, name="ff")(_norm(cfg, "ff_norm")(x))
+        return x
+
+
+def _pad_bias(attention_mask, dtype=jnp.float32):
+    """[B, K] 1/0 mask → additive [B, 1, 1, K] (0 keep / -inf drop)."""
+    return (1.0 - attention_mask.astype(jnp.float32))[:, None, None, :] * jnp.finfo(dtype).min
+
+
+class T5(nn.Module):
+    """``__call__(input_ids, decoder_input_ids, attention_mask=None,
+    decoder_attention_mask=None) -> logits [B, T, V]``.
+
+    The full encoder + decoder forward as one jittable program; the relative
+    bias tables live in each stack's block 0 (``encoder_rel_bias`` /
+    ``decoder_rel_bias``) and are shared by the deeper blocks, exactly
+    matching the HF checkpoint layout.
+    """
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids,
+                 attention_mask=None, decoder_attention_mask=None):
+        cfg = self.config
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.initializers.normal(1.0), name="shared",
+        )
+        b, s = input_ids.shape
+        t = decoder_input_ids.shape[1]
+
+        # ---- encoder ----
+        x = embed(input_ids)
+        enc_bias = T5RelativeBias(cfg, bidirectional=True, name="encoder_rel_bias")(s, s)
+        if attention_mask is not None:
+            enc_bias = enc_bias + _pad_bias(attention_mask)
+        for i in range(cfg.num_layers):
+            x = T5Block(cfg, has_cross=False, name=f"encoder_block_{i}")(x, enc_bias)
+        enc_out = _norm(cfg, "encoder_final_norm")(x)
+
+        # ---- decoder ----
+        y = embed(decoder_input_ids)
+        dec_bias = T5RelativeBias(cfg, bidirectional=False, name="decoder_rel_bias")(t, t)
+        causal = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0,
+            jnp.finfo(jnp.float32).min,
+        )[None, None]
+        dec_bias = dec_bias + causal
+        if decoder_attention_mask is not None:
+            dec_bias = dec_bias + _pad_bias(decoder_attention_mask)
+        cross_bias = jnp.zeros((1, 1, 1, 1), jnp.float32)
+        if attention_mask is not None:
+            cross_bias = cross_bias + _pad_bias(attention_mask)
+        for i in range(cfg.num_decoder_layers):
+            y = T5Block(cfg, has_cross=True, name=f"decoder_block_{i}")(
+                y, dec_bias, enc_out=enc_out, cross_bias=cross_bias
+            )
+        y = _norm(cfg, "decoder_final_norm")(y)
+
+        if cfg.tie_word_embeddings:
+            # v1.0 ties the head AND rescales (T5's d_model**-0.5 head scale)
+            y = y * (cfg.d_model ** -0.5)
+            logits = embed.attend(y.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="lm_head",
+            )(y)
+        return logits.astype(jnp.float32)
+
+
+# --------------------------------------------------------------- HF interop
+from .hf_compat import _ident, _t  # noqa: E402  (shared torch-layout transforms)
+
+
+def t5_key_map(cfg: T5Config) -> Dict[str, Tuple[str, Any]]:
+    """native key -> (hf key, transform) for T5/flan-T5 naming."""
+    m: Dict[str, Tuple[str, Any]] = {
+        "shared.embedding": ("shared.weight", _ident),
+        "encoder_final_norm.scale": ("encoder.final_layer_norm.weight", _ident),
+        "decoder_final_norm.scale": ("decoder.final_layer_norm.weight", _ident),
+        "encoder_rel_bias.embedding": (
+            "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight", _ident),
+        "decoder_rel_bias.embedding": (
+            "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight", _ident),
+    }
+    if not cfg.tie_word_embeddings:
+        m["lm_head.kernel"] = ("lm_head.weight", _t)
+
+    def attn(native_prefix, hf_prefix):
+        for ours, theirs in (("q_proj", "q"), ("k_proj", "k"),
+                             ("v_proj", "v"), ("o_proj", "o")):
+            m[f"{native_prefix}.{ours}.kernel"] = (f"{hf_prefix}.{theirs}.weight", _t)
+
+    def ff(native_prefix, hf_layer):
+        hf_ff = f"{hf_layer}.DenseReluDense"
+        m[f"{native_prefix}_norm.scale"] = (f"{hf_layer}.layer_norm.weight", _ident)
+        if cfg.gated_ff:
+            m[f"{native_prefix}.wi_0.kernel"] = (f"{hf_ff}.wi_0.weight", _t)
+            m[f"{native_prefix}.wi_1.kernel"] = (f"{hf_ff}.wi_1.weight", _t)
+        else:
+            m[f"{native_prefix}.wi.kernel"] = (f"{hf_ff}.wi.weight", _t)
+        m[f"{native_prefix}.wo.kernel"] = (f"{hf_ff}.wo.weight", _t)
+
+    for i in range(cfg.num_layers):
+        n, h = f"encoder_block_{i}", f"encoder.block.{i}"
+        attn(f"{n}.self_attn", f"{h}.layer.0.SelfAttention")
+        m[f"{n}.self_norm.scale"] = (f"{h}.layer.0.layer_norm.weight", _ident)
+        ff(f"{n}.ff", f"{h}.layer.1")
+    for i in range(cfg.num_decoder_layers):
+        n, h = f"decoder_block_{i}", f"decoder.block.{i}"
+        attn(f"{n}.self_attn", f"{h}.layer.0.SelfAttention")
+        attn(f"{n}.cross_attn", f"{h}.layer.1.EncDecAttention")
+        m[f"{n}.self_norm.scale"] = (f"{h}.layer.0.layer_norm.weight", _ident)
+        m[f"{n}.cross_norm.scale"] = (f"{h}.layer.1.layer_norm.weight", _ident)
+        ff(f"{n}.ff", f"{h}.layer.2")
+    return m
+
+
+def load_hf_t5(checkpoint: str, dtype=None, **config_overrides):
+    """HF ``t5-*`` / ``flan-t5-*`` snapshot dir → ``(model, params)``.
+
+    Streams safetensors/torch-bin shards one tensor at a time through the
+    decoder interop's readers; tied checkpoints drop the duplicate lm_head.
+    """
+    from ..utils.modeling import unflatten_tree
+    from .hf_compat import stream_mapped_tensors
+
+    with open(os.path.join(checkpoint, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if hf_cfg.get("model_type") != "t5":
+        raise ValueError(f"{checkpoint} is not a t5 checkpoint")
+    cfg = T5Config.from_hf(hf_cfg, **config_overrides)
+    flat = stream_mapped_tensors(checkpoint, t5_key_map(cfg), dtype=dtype)
+    return T5(cfg), unflatten_tree(flat)
