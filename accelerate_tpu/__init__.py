@@ -42,6 +42,8 @@ from .models import (
     BertEncoder,
     T5,
     T5Config,
+    ViTConfig,
+    ViTEncoder,
     GenerationConfig,
     KVCache,
     config_from_hf,
@@ -50,6 +52,7 @@ from .models import (
     load_hf_bert,
     load_hf_checkpoint,
     load_hf_t5,
+    load_hf_vit,
     make_decode_step,
     make_prefill_step,
     sample_tokens,

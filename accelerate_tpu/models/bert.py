@@ -44,6 +44,10 @@ class BertConfig:
     # MLM-only exports (BertForMaskedLM uses add_pooling_layer=False) carry
     # no pooler weights; load_hf_bert flips this off when they are absent
     add_pooler: bool = True
+    # RoBERTa convention: positions are pad-aware cumulative counts offset by
+    # pad_token_id + 1 (pads read row pad_token_id), not a plain arange
+    roberta_positions: bool = False
+    pad_token_id: int = 1
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -59,6 +63,9 @@ class BertConfig:
             type_vocab_size=hf.get("type_vocab_size", 2),
             layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
         )
+        if hf.get("model_type") == "roberta":
+            fields["roberta_positions"] = True
+            fields["pad_token_id"] = hf.get("pad_token_id", 1)
         act = hf.get("hidden_act", "gelu")
         if act != "gelu":
             raise NotImplementedError(f"bert hidden_act {act!r} is not mapped")
@@ -112,9 +119,14 @@ class BertEncoder(nn.Module):
             n, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
         )
         word = embed("word_embeddings", cfg.vocab_size)
+        if cfg.roberta_positions:
+            nonpad = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+            positions = jnp.cumsum(nonpad, axis=1) * nonpad + cfg.pad_token_id
+        else:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         x = (
             word(input_ids)
-            + embed("position_embeddings", cfg.max_seq_len)(jnp.arange(s)[None, :])
+            + embed("position_embeddings", cfg.max_seq_len)(positions)
             + embed("token_type_embeddings", cfg.type_vocab_size)(token_type_ids)
         )
         x = _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="embed_norm")(x)
@@ -199,6 +211,15 @@ _MLM_MAP = {
     "decoder_bias": ("cls.predictions.bias", _ident),
 }
 
+# RoBERTa's MLM head: same transform stack, different naming
+_ROBERTA_MLM_MAP = {
+    "transform.kernel": ("lm_head.dense.weight", _t),
+    "transform.bias": ("lm_head.dense.bias", _ident),
+    "transform_norm.scale": ("lm_head.layer_norm.weight", _ident),
+    "transform_norm.bias": ("lm_head.layer_norm.bias", _ident),
+    "decoder_bias": ("lm_head.bias", _ident),
+}
+
 
 def load_hf_bert(checkpoint: str, dtype=None, **config_overrides):
     """HF ``bert-base-*`` snapshot dir → ``(encoder, params, mlm_params)``.
@@ -211,22 +232,25 @@ def load_hf_bert(checkpoint: str, dtype=None, **config_overrides):
 
     with open(os.path.join(checkpoint, "config.json")) as f:
         hf_cfg = json.load(f)
-    if hf_cfg.get("model_type") != "bert":
-        raise ValueError(f"{checkpoint} is not a bert checkpoint")
+    model_type = hf_cfg.get("model_type")
+    if model_type not in ("bert", "roberta"):
+        raise ValueError(f"{checkpoint} is not a bert/roberta checkpoint")
     # shard-index keys are enough to sniff the layout — no tensor loads yet
     from ..big_modeling import _checkpoint_files
     from .hf_compat import stream_mapped_tensors
 
     hf_keys = set(_checkpoint_files(checkpoint))
-    prefix = "bert." if any(k.startswith("bert.") for k in hf_keys) else ""
+    scope = f"{model_type}."  # "bert." / "roberta." scoped exports
+    prefix = scope if any(k.startswith(scope) for k in hf_keys) else ""
     if f"{prefix}pooler.dense.weight" not in hf_keys:
         config_overrides.setdefault("add_pooler", False)
     cfg = BertConfig.from_hf(hf_cfg, **config_overrides)
 
     mapping = bert_key_map(cfg, prefix)
-    has_mlm = "cls.predictions.transform.dense.weight" in hf_keys
+    mlm_map = _ROBERTA_MLM_MAP if model_type == "roberta" else _MLM_MAP
+    has_mlm = mlm_map["transform.kernel"][0] in hf_keys
     if has_mlm:
-        mapping.update({f"__mlm__.{native}": spec for native, spec in _MLM_MAP.items()})
+        mapping.update({f"__mlm__.{native}": spec for native, spec in mlm_map.items()})
     flat = stream_mapped_tensors(checkpoint, mapping, dtype=dtype)
     mlm_flat = {k[len("__mlm__."):]: v for k, v in flat.items() if k.startswith("__mlm__.")}
     params = unflatten_tree({k: v for k, v in flat.items() if not k.startswith("__mlm__.")})

@@ -1,0 +1,195 @@
+"""ViT-family vision transformer: patch-embedding encoder + HF interop.
+
+The reference's CV story is torchvision-through-Accelerator
+(``/root/reference/examples/cv_example.py:1-50``); this repo's native CV
+pair is the ResNet (``models/resnet.py``) for the convnet class and this
+module for the vision-transformer class — architecture-exact ViT (conv
+patch embedding, CLS token, learned positions, PRE-LN blocks with erf-gelu
+MLP, final LayerNorm, optional tanh pooler) plus the ``vit-base-*`` HF key
+mapping with logits parity vs torch
+(``tests/test_hf_compat.py::TestViTParity``).
+
+TPU-first: the patch projection is one strided conv (XLA maps it onto the
+MXU as an implicit GEMM), everything downstream is the same static-shape
+attention/GEMM diet as the text encoders; NHWC layout throughout (the TPU
+conv-native layout — the HF interop transposes NCHW weights once at load).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import LayerNorm as _LayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-12
+    add_pooler: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], **overrides) -> "ViTConfig":
+        act = hf.get("hidden_act", "gelu")
+        if act != "gelu":
+            raise NotImplementedError(f"vit hidden_act {act!r} is not mapped")
+        fields = dict(
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            image_size=hf.get("image_size", 224),
+            patch_size=hf.get("patch_size", 16),
+            num_channels=hf.get("num_channels", 3),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+        )
+        fields.update(overrides)
+        return cls(**fields)
+
+
+class ViTLayer(nn.Module):
+    """PRE-LN block (unlike BERT's post-LN): x += attn(ln(x)); x += mlp(ln(x))."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        d = cfg.hidden_size // cfg.num_heads
+        dense = lambda name, feat: nn.Dense(
+            feat, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        b, s, _ = x.shape
+        h = _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="norm_before")(x)
+        q = dense("query", cfg.hidden_size)(h).reshape(b, s, cfg.num_heads, d)
+        k = dense("key", cfg.hidden_size)(h).reshape(b, s, cfg.num_heads, d)
+        v = dense("value", cfg.hidden_size)(h).reshape(b, s, cfg.num_heads, d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, cfg.hidden_size)
+        x = x + dense("attn_out", cfg.hidden_size)(attn)
+        h = _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="norm_after")(x)
+        h = nn.gelu(dense("intermediate", cfg.intermediate_size)(h), approximate=False)
+        return x + dense("output", cfg.hidden_size)(h)
+
+
+class ViTEncoder(nn.Module):
+    """``__call__(pixels [B, H, W, C] NHWC) -> (sequence [B, 1+P, H],
+    pooled [B, H])`` — position 0 is the CLS token."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixels):
+        cfg = self.config
+        b = pixels.shape[0]
+        x = nn.Conv(
+            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="patch_proj",
+        )(pixels)
+        x = x.reshape(b, -1, cfg.hidden_size)  # [B, P, H] row-major patches
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.hidden_size), cfg.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, cfg.hidden_size)).astype(x.dtype), x], axis=1)
+        pos = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.hidden_size), cfg.param_dtype)
+        x = x + pos.astype(x.dtype)
+        for i in range(cfg.num_layers):
+            x = ViTLayer(cfg, name=f"layers_{i}")(x)
+        x = _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if not cfg.add_pooler:
+            return x, x[:, 0]
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="pooler")(x[:, 0])
+        )
+        return x, pooled
+
+
+# --------------------------------------------------------------- HF interop
+from .hf_compat import _ident, _t  # noqa: E402  (shared torch-layout transforms)
+
+
+def _conv_t(x: np.ndarray) -> np.ndarray:
+    """torch Conv2d [out, in, kh, kw] → flax [kh, kw, in, out]."""
+    return np.ascontiguousarray(np.transpose(x, (2, 3, 1, 0)))
+
+
+def vit_key_map(cfg: ViTConfig, prefix: str = "vit.") -> Dict[str, Tuple[str, Any]]:
+    """native key -> (hf key, transform).  ``prefix=""`` serves bare
+    ``ViTModel`` exports."""
+    p = prefix
+    m: Dict[str, Tuple[str, Any]] = {
+        # cls/pos keep HF's leading [1, ...] dims — shapes already match ours
+        "cls_token": (f"{p}embeddings.cls_token", _ident),
+        "position_embeddings": (f"{p}embeddings.position_embeddings", _ident),
+        "patch_proj.kernel": (f"{p}embeddings.patch_embeddings.projection.weight", _conv_t),
+        "patch_proj.bias": (f"{p}embeddings.patch_embeddings.projection.bias", _ident),
+        "final_norm.scale": (f"{p}layernorm.weight", _ident),
+        "final_norm.bias": (f"{p}layernorm.bias", _ident),
+    }
+    if cfg.add_pooler:
+        m["pooler.kernel"] = (f"{p}pooler.dense.weight", _t)
+        m["pooler.bias"] = (f"{p}pooler.dense.bias", _ident)
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"{p}encoder.layer.{i}"
+        pairs = [
+            (f"{n}.query", f"{h}.attention.attention.query"),
+            (f"{n}.key", f"{h}.attention.attention.key"),
+            (f"{n}.value", f"{h}.attention.attention.value"),
+            (f"{n}.attn_out", f"{h}.attention.output.dense"),
+            (f"{n}.intermediate", f"{h}.intermediate.dense"),
+            (f"{n}.output", f"{h}.output.dense"),
+        ]
+        for native, hf in pairs:
+            m[f"{native}.kernel"] = (f"{hf}.weight", _t)
+            m[f"{native}.bias"] = (f"{hf}.bias", _ident)
+        m[f"{n}.norm_before.scale"] = (f"{h}.layernorm_before.weight", _ident)
+        m[f"{n}.norm_before.bias"] = (f"{h}.layernorm_before.bias", _ident)
+        m[f"{n}.norm_after.scale"] = (f"{h}.layernorm_after.weight", _ident)
+        m[f"{n}.norm_after.bias"] = (f"{h}.layernorm_after.bias", _ident)
+    return m
+
+
+def load_hf_vit(checkpoint: str, dtype=None, **config_overrides):
+    """HF ``vit-base-*`` snapshot dir → ``(ViTEncoder, params)``.
+
+    Serves bare ``ViTModel`` exports and ``vit.``-scoped heads
+    (``ViTForImageClassification`` — which carries no pooler).
+    """
+    from ..big_modeling import _checkpoint_files
+    from ..utils.modeling import unflatten_tree
+    from .hf_compat import stream_mapped_tensors
+
+    with open(os.path.join(checkpoint, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if hf_cfg.get("model_type") != "vit":
+        raise ValueError(f"{checkpoint} is not a vit checkpoint")
+    hf_keys = set(_checkpoint_files(checkpoint))
+    prefix = "vit." if any(k.startswith("vit.") for k in hf_keys) else ""
+    if f"{prefix}pooler.dense.weight" not in hf_keys:
+        config_overrides.setdefault("add_pooler", False)
+    cfg = ViTConfig.from_hf(hf_cfg, **config_overrides)
+    flat = stream_mapped_tensors(checkpoint, vit_key_map(cfg, prefix), dtype=dtype)
+    return ViTEncoder(cfg), unflatten_tree(flat)

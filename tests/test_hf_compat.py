@@ -718,6 +718,93 @@ class TestT5Parity:
         self._assert_parity(tmp_path, model)
 
 
+class TestRobertaParity:
+    """RoBERTa rides the BERT encoder with pad-aware offset positions
+    (cumsum + pad_token_id, pads reading the pad row) and the lm_head-style
+    MLM naming."""
+
+    def test_mlm_with_padded_batch(self, tmp_path):
+        from accelerate_tpu.models.bert import load_hf_bert, masked_lm_logits
+
+        cfg = transformers.RobertaConfig(
+            vocab_size=128, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=66, type_vocab_size=1, pad_token_id=1,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+        torch.manual_seed(22)
+        model = transformers.RobertaForMaskedLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        encoder, params, mlm = load_hf_bert(str(tmp_path))
+        assert encoder.config.roberta_positions and mlm is not None
+        rng = np.random.default_rng(22)
+        ids = rng.integers(2, 128, size=(2, 12)).astype(np.int64)
+        ids[1, 8:] = 1  # padded row: offset positions must skip pads
+        mask = (ids != 1).astype(np.int64)
+        ours = masked_lm_logits(encoder, params, jnp.asarray(ids),
+                                attention_mask=jnp.asarray(mask), mlm_params=mlm)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(ids),
+                        attention_mask=torch.from_numpy(mask)).logits.float().numpy()
+        keep = mask.astype(bool)
+        np.testing.assert_allclose(
+            np.asarray(ours)[keep], ref[keep], rtol=3e-4, atol=3e-4
+        )
+
+
+class TestViTParity:
+    """Vision-transformer family: conv patch embedding (NCHW->NHWC weight
+    transpose), CLS token, learned positions, pre-LN blocks."""
+
+    def _cfg(self):
+        return transformers.ViTConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=48, image_size=16, patch_size=8,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+
+    def _pixels(self):
+        rng = np.random.default_rng(20)
+        return rng.standard_normal((2, 3, 16, 16)).astype(np.float32)  # NCHW
+
+    def test_encoder_matches_torch(self, tmp_path):
+        from accelerate_tpu.models.vit import load_hf_vit
+
+        torch.manual_seed(20)
+        model = transformers.ViTModel(self._cfg()).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        encoder, params = load_hf_vit(str(tmp_path))
+        px = self._pixels()
+        seq, pooled = encoder.apply(
+            {"params": params}, jnp.asarray(np.transpose(px, (0, 2, 3, 1)))
+        )
+        with torch.no_grad():
+            out = model(torch.from_numpy(px))
+        np.testing.assert_allclose(
+            np.asarray(seq), out.last_hidden_state.numpy(), rtol=3e-4, atol=3e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(pooled), out.pooler_output.numpy(), rtol=3e-4, atol=3e-4
+        )
+
+    def test_classification_export_prefix(self, tmp_path):
+        """ViTForImageClassification: 'vit.'-scoped keys, no pooler."""
+        from accelerate_tpu.models.vit import load_hf_vit
+
+        torch.manual_seed(21)
+        model = transformers.ViTForImageClassification(self._cfg()).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        encoder, params = load_hf_vit(str(tmp_path))
+        assert not encoder.config.add_pooler
+        px = self._pixels()
+        seq, _cls = encoder.apply(
+            {"params": params}, jnp.asarray(np.transpose(px, (0, 2, 3, 1)))
+        )
+        with torch.no_grad():
+            ref = model.vit(torch.from_numpy(px)).last_hidden_state.numpy()
+        np.testing.assert_allclose(np.asarray(seq), ref, rtol=3e-4, atol=3e-4)
+
+
 class TestDispatchIntegration:
     def test_auto_detect_and_dispatch(self, tmp_path):
         """load_checkpoint_and_dispatch pointed at the RAW HF dir: detects,
