@@ -46,7 +46,7 @@ __all__ = [
 # architectures with a key mapping; config.json "model_type" values
 SUPPORTED_MODEL_TYPES = (
     "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
-    "phi3", "falcon", "stablelm", "gpt_bigcode",
+    "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral",
 )
 
 
@@ -263,6 +263,25 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
         )
         if hf.get("attention_bias", False):
             fields["attn_bias"] = True
+    elif model_type == "mixtral":
+        # Mistral recipe with the dense MLP replaced by top-k sparse MoE.
+        # The routing math matches parallel/moe.top_k_dispatch exactly
+        # (softmax over all experts -> top-k -> renormalize the selected
+        # gates); torch computes the exact capacity-less mixture, so load
+        # with a drop-free capacity factor — fine-tuning at pod scale
+        # should lower expert_capacity_factor again.
+        fields = _llama_base_fields(hf)
+        k = hf.get("num_experts_per_tok", 2)
+        fields.update(
+            sliding_window=hf.get("sliding_window"),
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=k,
+            router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
+            # drop-free minimum: top-k experts are distinct per token, so the
+            # worst-case per-expert load is N tokens = factor E/k in
+            # resolved_expert_capacity's N*k/E share
+            expert_capacity_factor=hf["num_local_experts"] / k,
+        )
     elif model_type == "phi3":
         # Llama recipe with FUSED projections (qkv_proj / gate_up_proj —
         # split in the key map) and an optional sliding window
@@ -736,6 +755,30 @@ def bigcode_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     return m
 
 
+def _stack_t(parts) -> np.ndarray:
+    """Gather transform: per-expert torch [out, in] weights → [E, in, out]."""
+    return np.stack([_t(p) for p in parts], axis=0)
+
+
+def mixtral_key_map(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Mixtral naming: Llama attention/norm tree + ``block_sparse_moe``
+    (router ``gate`` + per-expert w1/w3/w2 = gate/up/down, stacked onto the
+    vmapped ``[E, ...]`` expert axis via converter GATHER entries)."""
+    m = {k: v for k, v in llama_key_map(cfg).items() if ".mlp." not in k}
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"model.layers.{i}"
+        m[f"{n}.moe_mlp.router.kernel"] = (f"{h}.block_sparse_moe.gate.weight", _t)
+        for ours, theirs in (("gate_proj", "w1"), ("up_proj", "w3"), ("down_proj", "w2")):
+            m[f"{n}.moe_mlp.experts.{ours}.kernel"] = (
+                tuple(
+                    f"{h}.block_sparse_moe.experts.{e}.{theirs}.weight"
+                    for e in range(cfg.num_experts)
+                ),
+                _stack_t,
+            )
+    return m
+
+
 def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
     """(config, {native_key: (hf_key, transform)}) for a HF model dir."""
     hf = _read_hf_config(checkpoint)
@@ -756,6 +799,8 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = falcon_key_map(cfg, hf.get("new_decoder_architecture", False))
     elif hf["model_type"] == "gpt_bigcode":
         mapping = bigcode_key_map(cfg)
+    elif hf["model_type"] == "mixtral":
+        mapping = mixtral_key_map(cfg)
     else:  # llama recipe: llama / mistral / qwen2 / gemma / stablelm
         mapping = llama_key_map(cfg)
     return cfg, mapping
@@ -827,9 +872,11 @@ def convert_hf_checkpoint(
 
     One streamed pass: each shard is written to disk the moment it fills
     (temp name, renamed once the final shard count is known), so peak RAM is
-    O(one source shard + one output shard), not O(model).  ``dtype``
-    optionally casts en route (e.g. ``jnp.bfloat16`` halves fp32 GPT-2
-    checkpoints on disk).
+    O(one source shard + one output shard + any in-flight GATHER buffers),
+    not O(model).  GATHER natives (Mixtral's stacked experts) hold their
+    source tensors until the stack completes — up to a few per-layer expert
+    matrices across a shard boundary.  ``dtype`` optionally casts en route
+    (e.g. ``jnp.bfloat16`` halves fp32 GPT-2 checkpoints on disk).
 
     Single-process only: on a multi-host job every process would race the
     same output files — convert once up front (one process, or a separate
@@ -861,10 +908,22 @@ def convert_hf_checkpoint(
         )
 
     cfg, mapping = native_key_map(checkpoint)
-    # invert: hf_key -> [(native_key, transform)] (c_attn fans out to 6)
+    # invert: hf_key -> [(native_key, transform)] (c_attn fans out to 6).
+    # GATHER entries — native: ((hf_key, ...), stack_transform) — collect
+    # several HF tensors into one native tensor (Mixtral stacks per-expert
+    # weights onto the vmapped [E, ...] axis); their sources buffer in
+    # `gather_buf` until complete, then emit through the same shard stream.
     by_hf: Dict[str, list] = {}
+    gather_sources: Dict[str, list] = {}  # hf_key -> [native]
+    gather_spec: Dict[str, Tuple[Tuple[str, ...], Callable]] = {}
     for native, (hf_key, transform) in mapping.items():
-        by_hf.setdefault(hf_key, []).append((native, transform))
+        if isinstance(hf_key, (list, tuple)):
+            gather_spec[native] = (tuple(hf_key), transform)
+            for k in hf_key:
+                gather_sources.setdefault(k, []).append(native)
+        else:
+            by_hf.setdefault(hf_key, []).append((native, transform))
+    gather_buf: Dict[str, Dict[str, np.ndarray]] = {n: {} for n in gather_spec}
 
     os.makedirs(out_dir, exist_ok=True)
     # a fresh conversion must not leave stale outputs behind: a leftover
@@ -888,25 +947,36 @@ def convert_hf_checkpoint(
             shard_keys.append(list(current))
             current, current_bytes = {}, 0
 
+    def emit(native, t):
+        nonlocal current_bytes
+        if dtype is not None:
+            import jax.numpy as jnp
+
+            t = t.astype(jnp.dtype(dtype))
+        if current_bytes + t.nbytes > max_shard_bytes:
+            flush()
+        current[native] = t
+        current_bytes += t.nbytes
+        seen.add(native)
+
     for hf_key, tensor in _iter_hf_tensors(checkpoint):
         targets = by_hf.get(hf_key)
-        if targets is None:
+        gathers = gather_sources.get(hf_key)
+        if targets is None and gathers is None:
             # HF checkpoints carry non-parameter buffers (GPT-2 attn.bias
             # causal masks, rotary inv_freq caches) and tied-duplicate
             # lm_head entries — skip, but remember for the mismatch report
             skipped.append(hf_key)
             continue
-        for native, transform in targets:
-            t = transform(tensor)
-            if dtype is not None:
-                import jax.numpy as jnp
-
-                t = t.astype(jnp.dtype(dtype))
-            if current_bytes + t.nbytes > max_shard_bytes:
-                flush()
-            current[native] = t
-            current_bytes += t.nbytes
-            seen.add(native)
+        for native, transform in targets or ():
+            emit(native, transform(tensor))
+        for native in gathers or ():
+            keys, stack_transform = gather_spec[native]
+            gather_buf[native][hf_key] = np.asarray(tensor)
+            if len(gather_buf[native]) == len(keys):
+                parts = [gather_buf[native][k] for k in keys]  # spec order
+                emit(native, stack_transform(parts))
+                gather_buf[native] = {}
     flush()
 
     missing = sorted(set(mapping) - seen)

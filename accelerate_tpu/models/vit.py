@@ -53,6 +53,10 @@ class ViTConfig:
         act = hf.get("hidden_act", "gelu")
         if act != "gelu":
             raise NotImplementedError(f"vit hidden_act {act!r} is not mapped")
+        if not hf.get("qkv_bias", True):
+            # ViTLayer has no bias-free mode; fail at config time, not deep
+            # in the tensor stream
+            raise NotImplementedError("vit qkv_bias=false is not mapped")
         fields = dict(
             hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],

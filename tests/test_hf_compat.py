@@ -718,6 +718,38 @@ class TestT5Parity:
         self._assert_parity(tmp_path, model)
 
 
+class TestMixtralParity:
+    """Mixtral (sparse MoE decoder): per-expert w1/w3/w2 stacked onto the
+    vmapped expert axis via converter GATHER entries, router gate mapped,
+    top-2 softmax-renormalized routing matching torch's exact mixture
+    (drop-free capacity at load)."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, num_local_experts=4,
+            num_experts_per_tok=2, sliding_window=None, pad_token_id=0,
+            attention_dropout=0.0,
+        )
+        torch.manual_seed(23)
+        model = transformers.MixtralForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.num_experts == 4 and cfg.num_experts_per_tok == 2
+        assert cfg.expert_capacity_factor == 2.0  # drop-free minimum (E/k)
+        rng = np.random.default_rng(23)
+        ids = rng.integers(1, 128, size=(2, 12)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        with torch.no_grad():
+            ref = model(torch.from_numpy(ids)).logits.float().numpy()
+        np.testing.assert_allclose(ours, ref, rtol=4e-4, atol=4e-4)
+
+
 class TestRobertaParity:
     """RoBERTa rides the BERT encoder with pad-aware offset positions
     (cumsum + pad_token_id, pads reading the pad row) and the lm_head-style
