@@ -46,7 +46,7 @@ __all__ = [
 # architectures with a key mapping; config.json "model_type" values
 SUPPORTED_MODEL_TYPES = (
     "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
-    "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral",
+    "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral", "phi",
 )
 
 
@@ -276,11 +276,34 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             sliding_window=hf.get("sliding_window"),
             num_experts=hf["num_local_experts"],
             num_experts_per_tok=k,
-            router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
+            router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.001),
             # drop-free minimum: top-k experts are distinct per token, so the
             # worst-case per-expert load is N tokens = factor E/k in
             # resolved_expert_capacity's N*k/E share
             expert_capacity_factor=hf["num_local_experts"] / k,
+        )
+    elif model_type == "phi":
+        # Phi-1/Phi-2: GPT-J-style block (parallel residual, ONE shared
+        # LayerNorm) with llama-style member naming, biases everywhere
+        # (incl. the untied lm_head), partial rotate-half rotary, gelu_new
+        act = hf.get("hidden_act", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise NotImplementedError(f"phi hidden_act {act!r} is not mapped")
+        if hf.get("qk_layernorm", False):
+            raise NotImplementedError("phi qk_layernorm=true is not mapped")
+        if hf.get("rope_scaling"):
+            raise NotImplementedError("phi rope_scaling is not mapped")
+        fields = _llama_base_fields(hf)
+        head_dim = fields["hidden_size"] // fields["num_heads"]
+        fields.update(
+            norm_type="layernorm",
+            rms_norm_eps=hf.get("layer_norm_eps", 1e-5),
+            use_bias=True,
+            lm_head_bias=True,
+            mlp_variant="gelu",
+            parallel_residual=True,
+            shared_norm=True,
+            rope_dim=int(hf.get("partial_rotary_factor", 0.5) * head_dim),
         )
     elif model_type == "phi3":
         # Llama recipe with FUSED projections (qkv_proj / gate_up_proj —
@@ -755,6 +778,33 @@ def bigcode_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     return m
 
 
+def phi_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """Phi-1/Phi-2 naming: llama-style ``model.layers.{i}.self_attn`` tree
+    with ``dense``/``fc1``/``fc2`` members, one shared ``input_layernorm``
+    per block (GPT-J-style parallel residual), biases throughout."""
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("model.embed_tokens.weight", _ident),
+        "final_norm.scale": ("model.final_layernorm.weight", _ident),
+        "final_norm.bias": ("model.final_layernorm.bias", _ident),
+        "lm_head.kernel": ("lm_head.weight", _t),
+        "lm_head.bias": ("lm_head.bias", _ident),
+    }
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"model.layers.{i}"
+        m[f"{n}.input_norm.scale"] = (f"{h}.input_layernorm.weight", _ident)
+        m[f"{n}.input_norm.bias"] = (f"{h}.input_layernorm.bias", _ident)
+        for ours, theirs in (("q_proj", "self_attn.q_proj"),
+                             ("k_proj", "self_attn.k_proj"),
+                             ("v_proj", "self_attn.v_proj"),
+                             ("o_proj", "self_attn.dense")):
+            m[f"{n}.attn.{ours}.kernel"] = (f"{h}.{theirs}.weight", _t)
+            m[f"{n}.attn.{ours}.bias"] = (f"{h}.{theirs}.bias", _ident)
+        for ours, theirs in (("up_proj", "mlp.fc1"), ("down_proj", "mlp.fc2")):
+            m[f"{n}.mlp.{ours}.kernel"] = (f"{h}.{theirs}.weight", _t)
+            m[f"{n}.mlp.{ours}.bias"] = (f"{h}.{theirs}.bias", _ident)
+    return m
+
+
 def _stack_t(parts) -> np.ndarray:
     """Gather transform: per-expert torch [out, in] weights → [E, in, out]."""
     return np.stack([_t(p) for p in parts], axis=0)
@@ -801,6 +851,8 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = bigcode_key_map(cfg)
     elif hf["model_type"] == "mixtral":
         mapping = mixtral_key_map(cfg)
+    elif hf["model_type"] == "phi":
+        mapping = phi_key_map(cfg)
     else:  # llama recipe: llama / mistral / qwen2 / gemma / stablelm
         mapping = llama_key_map(cfg)
     return cfg, mapping

@@ -469,6 +469,36 @@ class TestPhi3Parity:
             _config_from_hf_dict(hf)
 
 
+class TestPhiParity:
+    """Phi-1/Phi-2: GPT-J-style shared-norm parallel residual with
+    llama-style naming, biases everywhere (incl. lm_head), partial
+    rotate-half rotary, gelu_new MLP."""
+
+    def _save_tiny(self, tmp_path):
+        cfg = transformers.PhiConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+            max_position_embeddings=64, partial_rotary_factor=0.5,
+            resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0,
+            pad_token_id=0,
+        )
+        torch.manual_seed(26)
+        model = transformers.PhiForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        return model
+
+    def test_logits_match_torch(self, tmp_path):
+        model = self._save_tiny(tmp_path)
+        cfg = config_from_hf(str(tmp_path))
+        assert cfg.parallel_residual and cfg.shared_norm
+        assert cfg.rope_dim == 8 and not cfg.rope_interleaved  # 0.5 * 16
+        assert cfg.lm_head_bias and cfg.use_bias
+        rng = np.random.default_rng(26)
+        ids = rng.integers(1, 128, size=(2, 15)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+
 class TestFalconParity:
     """Falcon family, both generations: 7B style (multi-query fused qkv, one
     shared norm, parallel residual) and 40B/180B style
@@ -745,9 +775,7 @@ class TestMixtralParity:
         rng = np.random.default_rng(23)
         ids = rng.integers(1, 128, size=(2, 12)).astype(np.int64)
         ours = _flax_logits(str(tmp_path), ids)
-        with torch.no_grad():
-            ref = model(torch.from_numpy(ids)).logits.float().numpy()
-        np.testing.assert_allclose(ours, ref, rtol=4e-4, atol=4e-4)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=4e-4, atol=4e-4)
 
 
 class TestRobertaParity:
