@@ -831,22 +831,27 @@ class StreamingTransformer(StreamingExecutor):
                 new_vs.append(nv)
             return x, tuple(new_ks), tuple(new_vs)
 
+        has_embed_norm = getattr(cfg, "embed_norm", False)
+        has_learned_pos = getattr(cfg, "positional", "rope") == "learned"
+
         def embed_fn(stage_params, ids, positions):
             import flax.linen as nn
 
             from .models.transformer import scale_embed
 
             embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-            if getattr(cfg, "positional", "rope") == "learned":
-                embed_params, pos_params = stage_params
-                x = scale_embed(cfg, embed.apply({"params": embed_params}, ids))
+            parts = list(stage_params) if isinstance(stage_params, tuple) else [stage_params]
+            x = scale_embed(cfg, embed.apply({"params": parts.pop(0)}, ids))
+            if has_embed_norm:  # BLOOM: LayerNorm right after the embedding
+                x = make_norm(cfg, None).apply({"params": parts.pop(0)}, x)
+            if has_learned_pos:
                 offset = getattr(cfg, "pos_offset", 0)
                 pos = nn.Embed(
                     cfg.max_seq_len + offset, cfg.hidden_size,
                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 )
-                return x + pos.apply({"params": pos_params}, positions + offset), positions
-            return scale_embed(cfg, embed.apply({"params": stage_params}, ids)), positions
+                x = x + pos.apply({"params": parts.pop(0)}, positions + offset)
+            return x, positions
 
         def head_fn(stage_params, x, positions):
             import flax.linen as nn
@@ -873,10 +878,14 @@ class StreamingTransformer(StreamingExecutor):
         self._embed_fn = embed_fn
         self._head_fn = head_fn
         self._cached_layer_fn = cached_layer_fn
+        embed_modules = ["embed_tokens"]
+        if has_embed_norm:
+            embed_modules.append("embed_norm")
+        if has_learned_pos:
+            embed_modules.append("pos_embed")
         embed_source = (
-            (lambda: (self._module_params("embed_tokens"), self._module_params("pos_embed")))
-            if getattr(cfg, "positional", "rope") == "learned"
-            else "embed_tokens"
+            "embed_tokens" if embed_modules == ["embed_tokens"]
+            else (lambda: tuple(self._module_params(m) for m in embed_modules))
         )
         plan = make_layer_plan(
             embed=(embed_source, embed_fn),

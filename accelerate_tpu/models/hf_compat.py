@@ -46,7 +46,7 @@ __all__ = [
 # architectures with a key mapping; config.json "model_type" values
 SUPPORTED_MODEL_TYPES = (
     "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
-    "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral", "phi",
+    "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral", "phi", "bloom",
 )
 
 
@@ -281,6 +281,35 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             # worst-case per-expert load is N tokens = factor E/k in
             # resolved_expert_capacity's N*k/E share
             expert_capacity_factor=hf["num_local_experts"] / k,
+        )
+    elif model_type == "bloom":
+        # BLOOM: alibi positions (no positional params), LayerNorm directly
+        # after the embedding, head-major fused qkv (NeoX layout), tanh-gelu
+        # MLP, biases throughout, tied embeddings
+        if hf.get("slow_but_exact", False):
+            raise NotImplementedError("bloom slow_but_exact attention is not mapped")
+        if hf.get("apply_residual_connection_post_layernorm", False):
+            # the bloomz-style post-norm residual is a different block function
+            raise NotImplementedError(
+                "bloom apply_residual_connection_post_layernorm=true is not mapped"
+            )
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=4 * hf["hidden_size"],
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            num_kv_heads=hf["n_head"],
+            # alibi has no position table; this only sizes the default KV
+            # cache (BloomConfig carries no sequence-length field)
+            max_seq_len=2048,
+            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            norm_type="layernorm",
+            use_bias=True,
+            positional="alibi",
+            embed_norm=True,
+            mlp_variant="gelu",
         )
     elif model_type == "phi":
         # Phi-1/Phi-2: GPT-J-style block (parallel residual, ONE shared
@@ -778,6 +807,38 @@ def bigcode_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     return m
 
 
+def bloom_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """BLOOM naming (``transformer.h.{i}.self_attention...``): head-major
+    fused qkv (NeoX layout — :func:`_neox_qkv_split` reused), embedding
+    LayerNorm, biases throughout, tied head."""
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("transformer.word_embeddings.weight", _ident),
+        "embed_norm.scale": ("transformer.word_embeddings_layernorm.weight", _ident),
+        "embed_norm.bias": ("transformer.word_embeddings_layernorm.bias", _ident),
+        "final_norm.scale": ("transformer.ln_f.weight", _ident),
+        "final_norm.bias": ("transformer.ln_f.bias", _ident),
+    }
+    if not cfg.tie_word_embeddings:
+        m["lm_head.kernel"] = ("lm_head.weight", _t)
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"transformer.h.{i}"
+        for norm, theirs in (("input_norm", "input_layernorm"),
+                             ("post_attn_norm", "post_attention_layernorm")):
+            m[f"{n}.{norm}.scale"] = (f"{h}.{theirs}.weight", _ident)
+            m[f"{n}.{norm}.bias"] = (f"{h}.{theirs}.bias", _ident)
+        qkv = f"{h}.self_attention.query_key_value"
+        for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            m[f"{n}.attn.{proj}.kernel"] = (f"{qkv}.weight", _neox_qkv_split(cfg, j))
+            m[f"{n}.attn.{proj}.bias"] = (f"{qkv}.bias", _neox_qkv_split(cfg, j))
+        m[f"{n}.attn.o_proj.kernel"] = (f"{h}.self_attention.dense.weight", _t)
+        m[f"{n}.attn.o_proj.bias"] = (f"{h}.self_attention.dense.bias", _ident)
+        m[f"{n}.mlp.up_proj.kernel"] = (f"{h}.mlp.dense_h_to_4h.weight", _t)
+        m[f"{n}.mlp.up_proj.bias"] = (f"{h}.mlp.dense_h_to_4h.bias", _ident)
+        m[f"{n}.mlp.down_proj.kernel"] = (f"{h}.mlp.dense_4h_to_h.weight", _t)
+        m[f"{n}.mlp.down_proj.bias"] = (f"{h}.mlp.dense_4h_to_h.bias", _ident)
+    return m
+
+
 def phi_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     """Phi-1/Phi-2 naming: llama-style ``model.layers.{i}.self_attn`` tree
     with ``dense``/``fc1``/``fc2`` members, one shared ``input_layernorm``
@@ -853,6 +914,8 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = mixtral_key_map(cfg)
     elif hf["model_type"] == "phi":
         mapping = phi_key_map(cfg)
+    elif hf["model_type"] == "bloom":
+        mapping = bloom_key_map(cfg)
     else:  # llama recipe: llama / mistral / qwen2 / gemma / stablelm
         mapping = llama_key_map(cfg)
     return cfg, mapping

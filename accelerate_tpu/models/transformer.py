@@ -106,7 +106,9 @@ class TransformerConfig:
     # mlp_variant="gelu" + tie_word_embeddings=True.
     norm_type: str = "rmsnorm"         # "rmsnorm" | "layernorm" (centered, with bias)
     use_bias: bool = False             # biases on attention/MLP projections
-    positional: str = "rope"           # "rope" | "learned" (wpe-style table)
+    # "alibi" (BLOOM/MPT): no positional params at all — per-head linear
+    # distance penalties added to the attention logits
+    positional: str = "rope"           # "rope" | "learned" (wpe-style table) | "alibi"
     # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact" the erf
     # form (GPT-NeoX); "relu" the OPT family; "geglu" the gated variant with
     # a tanh-gelu gate (Gemma) — same three-matrix layout as swiglu
@@ -141,6 +143,9 @@ class TransformerConfig:
     # scale, and embeddings are multiplied by sqrt(hidden_size).
     norm_unit_offset: bool = False
     embed_scale: bool = False
+    # BLOOM: a LayerNorm directly after the token embedding
+    # (word_embeddings_layernorm)
+    embed_norm: bool = False
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False                # jax.checkpoint each layer
@@ -198,9 +203,10 @@ class TransformerConfig:
             raise ValueError(
                 f"Unknown norm_type {self.norm_type!r}; choose 'rmsnorm' or 'layernorm'"
             )
-        if self.positional not in ("rope", "learned"):
+        if self.positional not in ("rope", "learned", "alibi"):
             raise ValueError(
-                f"Unknown positional {self.positional!r}; choose 'rope' or 'learned'"
+                f"Unknown positional {self.positional!r}; choose 'rope', "
+                "'learned' or 'alibi'"
             )
         if self.mlp_variant not in ("swiglu", "gelu", "gelu_exact", "relu", "geglu"):
             raise ValueError(
@@ -283,7 +289,7 @@ class KVCache(struct.PyTreeNode):
         return self.k.shape[2]
 
 
-def cached_attention(q, k, v, q_positions, window=None):
+def cached_attention(q, k, v, q_positions, window=None, alibi=False):
     """Attention of ``q`` [B,S,Hq,D] against a full cache ``k``/``v`` [B,M,Hkv,D].
 
     Key slot ``j`` is visible to query ``i`` iff ``j <= q_positions[i]`` —
@@ -303,6 +309,11 @@ def cached_attention(q, k, v, q_positions, window=None):
     scale = d ** -0.5
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
     j = jnp.arange(k.shape[1])
+    if alibi:
+        rel = (j[None, None, None, None, :]
+               - q_positions[:, None, None, :, None]).astype(jnp.float32)
+        slopes = alibi_slopes(n_q).reshape(n_kv, rep)
+        logits = logits + slopes[None, :, :, None, None] * rel
     mask = j[None, None, None, None, :] <= q_positions[:, None, None, :, None]  # [B,1,1,S,M]
     if window is not None:
         mask = mask & (
@@ -312,6 +323,31 @@ def cached_attention(q, k, v, q_positions, window=None):
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
     return out.reshape(b, s, n_q, d)
+
+
+def alibi_slopes(n_heads: int) -> jax.Array:
+    """Per-head alibi slopes — the Press et al. geometric sequence with the
+    HF non-power-of-2 correction (``build_alibi_tensor``): the closest power
+    of 2 gets the standard sequence, extra heads interleave from the
+    double-resolution sequence."""
+    import math
+
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    powers = [base ** (i + 1) for i in range(closest)]
+    if closest != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        powers += [extra_base ** (1 + 2 * i) for i in range(n_heads - closest)]
+    return jnp.asarray(powers, jnp.float32)
+
+
+def _alibi_bias(n_heads: int, k_len: int) -> jax.Array:
+    """[1, H, 1, K] additive bias ``slope_h * j`` (key position), broadcast
+    over queries.  Softmax-equivalent to the relative ``slope_h * (j - i)``
+    form (per-query-row shifts cancel) at 1/Q the memory — the bias constant
+    would otherwise rival the weights on big-model prefill."""
+    j = jnp.arange(k_len, dtype=jnp.float32)
+    return (alibi_slopes(n_heads)[:, None, None] * j[None, None, :])[None]
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -445,13 +481,18 @@ class Attention(nn.Module):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v.astype(v_cache.dtype), (0, index, 0, 0)
             )
-            out = cached_attention(q, k_cache, v_cache, positions, window=cfg.sliding_window)
+            out = cached_attention(q, k_cache, v_cache, positions,
+                                   window=cfg.sliding_window,
+                                   alibi=cfg.positional == "alibi")
             out = out.reshape(b, s, cfg.num_heads * hd)
             return dense("o_proj", cfg.hidden_size)(out), (k_cache, v_cache)
+        bias = None
+        if cfg.positional == "alibi":
+            bias = _alibi_bias(cfg.num_heads, s)
         out = dot_product_attention(
             q, k, v, causal=True, implementation=cfg.attention_impl,
             segment_ids=segment_ids, ring_layout=cfg.ring_attention_layout,
-            window=cfg.sliding_window,
+            window=cfg.sliding_window, bias=bias,
         )
         out = out.reshape(b, s, cfg.num_heads * hd)
         return _tag_proj(dense("o_proj", cfg.hidden_size)(out))
@@ -584,6 +625,8 @@ class Transformer(nn.Module):
             name="embed_tokens",
         )
         x = scale_embed(cfg, embed(input_ids))
+        if cfg.embed_norm:
+            x = make_norm(cfg, "embed_norm")(x)
         if cfg.positional == "learned":
             pos_embed = nn.Embed(
                 cfg.max_seq_len + cfg.pos_offset,

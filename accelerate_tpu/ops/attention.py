@@ -49,14 +49,16 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     ring_layout: str = "contiguous",
     window: Optional[int] = None,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """BSHD attention. GQA supported (k/v may have fewer heads than q).
 
     ``window`` enables sliding-window attention (Mistral-family,
     ``config.sliding_window``): query ``i`` sees keys ``j`` with
     ``i - window < j <= i`` — the causal band of width ``window`` including
-    self.  Currently the ``"xla"`` implementation only; the banded mask
-    composes with ``segment_ids``.
+    self.  ``bias`` is an additive pre-softmax logits bias broadcastable to
+    ``[B, H, Q, K]`` (alibi position penalties).  Both are currently the
+    ``"xla"`` implementation only and compose with ``segment_ids``.
     """
     if window is not None:
         if not causal:
@@ -66,6 +68,11 @@ def dot_product_attention(
                 f"window (sliding-window attention) is implemented for "
                 f"implementation='xla' only, got {implementation!r}."
             )
+    if bias is not None and implementation != "xla":
+        raise NotImplementedError(
+            f"bias (alibi) is implemented for implementation='xla' only, "
+            f"got {implementation!r}."
+        )
     if implementation == "pallas":
         from .flash_attention import flash_attention
 
@@ -156,10 +163,13 @@ def dot_product_attention(
         mask = band if mask is None else (mask & band)
     try:
         return jax.nn.dot_product_attention(
-            q, k, v, mask=mask, is_causal=causal, scale=scale, implementation=None
+            q, k, v, bias=bias, mask=mask, is_causal=causal, scale=scale,
+            implementation=None,
         )
     except TypeError:  # older signature
-        return _reference_attention(q, k, v, causal=causal, scale=scale, mask=mask)
+        return _reference_attention(
+            q, k, v, causal=causal, scale=scale, mask=mask, bias=bias
+        )
 
 
 def blocked_causal_attention(
@@ -222,9 +232,12 @@ def blocked_causal_attention(
     return jnp.concatenate(outs, axis=1).reshape(b, s, n_q, d)
 
 
-def _reference_attention(q, k, v, *, causal: bool, scale: Optional[float], mask=None):
+def _reference_attention(q, k, v, *, causal: bool, scale: Optional[float], mask=None,
+                         bias=None):
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
     if causal:
         logits = logits + causal_mask(q.shape[1], k.shape[1], logits.dtype)[None, None]
     if mask is not None:

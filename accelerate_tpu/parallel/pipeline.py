@@ -290,6 +290,14 @@ def pipeline_lm_loss_fn(
 
     cfg = model.config
     schedule_slots(schedule, 8, 1)  # validate the schedule name eagerly
+    if getattr(cfg, "embed_norm", False) or getattr(cfg, "positional", "rope") == "learned":
+        # the pipeline embed stage implements the rope/alibi recipe only;
+        # refusing beats silently skipping the embedding norm / position table
+        raise NotImplementedError(
+            "pipeline_lm_loss_fn supports rope/alibi configs without an "
+            "embedding norm; embed_norm / learned-position families "
+            "(BLOOM, GPT-2, OPT) train via fsdp/tp instead"
+        )
     is_moe = getattr(cfg, "num_experts", 0) > 0 and cfg.router_aux_loss_coef > 0.0
 
     if schedule == "1f1b":
