@@ -1158,3 +1158,31 @@ def load_hf_checkpoint(
         model, native, device_map=device_map, dtype=dtype, **dispatch_kwargs
     )
     return model, params, device_map, loader
+
+
+def _main():
+    """``python -m accelerate_tpu.models.hf_compat <hf_dir>`` — the
+    convert-once-up-front flow multi-host jobs need (see
+    :func:`convert_hf_checkpoint`'s single-process note)."""
+    import argparse
+
+    import jax.numpy as jnp
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("checkpoint", help="raw HF snapshot dir of a mapped family")
+    ap.add_argument("--out", default=None, help="output dir (default: <dir>/_atpu_native)")
+    ap.add_argument("--dtype", default=None, choices=["bf16", "f32", "f16"],
+                    help="cast en route (bf16 halves fp32 checkpoints on disk)")
+    ap.add_argument("--shard-gb", type=float, default=4.0, help="max output shard size")
+    ap.add_argument("--force", action="store_true", help="reconvert even if cached")
+    args = ap.parse_args()
+    dtype = {None: None, "bf16": jnp.bfloat16, "f32": jnp.float32, "f16": jnp.float16}[args.dtype]
+    out = convert_hf_checkpoint(
+        args.checkpoint, out_dir=args.out, dtype=dtype,
+        max_shard_bytes=int(args.shard_gb * (1 << 30)), force=args.force,
+    )
+    print(out)
+
+
+if __name__ == "__main__":
+    _main()
