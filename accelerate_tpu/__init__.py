@@ -44,6 +44,8 @@ from .models import (
     T5Config,
     ViTConfig,
     ViTEncoder,
+    Whisper,
+    WhisperConfig,
     GenerationConfig,
     KVCache,
     config_from_hf,
@@ -53,6 +55,7 @@ from .models import (
     load_hf_checkpoint,
     load_hf_t5,
     load_hf_vit,
+    load_hf_whisper,
     make_decode_step,
     make_prefill_step,
     sample_tokens,

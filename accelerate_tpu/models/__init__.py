@@ -3,6 +3,7 @@
 from .bert import BertConfig, BertEncoder, load_hf_bert, masked_lm_logits
 from .t5 import T5, T5Config, load_hf_t5
 from .vit import ViTConfig, ViTEncoder, load_hf_vit
+from .whisper import Whisper, WhisperConfig, load_hf_whisper
 from .generation import GenerationConfig, generate, make_decode_step, make_prefill_step, sample_tokens
 from .hf_compat import config_from_hf, convert_hf_checkpoint, load_hf_checkpoint, to_scan_layout
 from .transformer import KVCache, Transformer, TransformerConfig, cross_entropy_loss, lm_loss_fn
@@ -14,6 +15,8 @@ __all__ = [
     "T5Config",
     "ViTConfig",
     "ViTEncoder",
+    "Whisper",
+    "WhisperConfig",
     "GenerationConfig",
     "KVCache",
     "Transformer",
@@ -27,6 +30,7 @@ __all__ = [
     "load_hf_checkpoint",
     "load_hf_t5",
     "load_hf_vit",
+    "load_hf_whisper",
     "masked_lm_logits",
     "make_decode_step",
     "make_prefill_step",

@@ -1,0 +1,273 @@
+"""Whisper-family speech encoder-decoder: audio frontend + HF interop.
+
+The reference is model-agnostic and its users run Whisper through
+``AutoModel`` like any seq2seq (the framework surface is identical —
+``/root/reference/examples/by_feature/multi_process_metrics.py:1-30`` style
+loops); this module provides the architecture natively: log-mel features →
+two gelu'd 1-D convs (the second stride-2) + fixed sinusoidal positions →
+pre-LN encoder; learned-position pre-LN decoder with causal self- and
+cross-attention; tied output head.  ``load_hf_whisper`` maps any
+``whisper-*`` snapshot and reproduces torch logits
+(``tests/test_hf_compat.py::TestWhisperParity``).
+
+TPU-first: the convs are NWC feature-last (XLA's conv-native layout — the
+interop transposes torch's [out, in, k] once at load), everything else is
+the same static-shape attention/GEMM diet as the text encoder-decoders; the
+full audio→logits forward jits as one program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import LayerNorm as _LayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    vocab_size: int = 51865
+    d_model: int = 384
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    num_heads: int = 6                 # same count both stacks in practice
+    encoder_ffn_dim: int = 1536
+    decoder_ffn_dim: int = 1536
+    num_mel_bins: int = 80
+    max_source_positions: int = 1500   # frames after the stride-2 conv
+    max_target_positions: int = 448
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], **overrides) -> "WhisperConfig":
+        if hf.get("encoder_attention_heads") != hf.get("decoder_attention_heads"):
+            raise NotImplementedError("whisper asymmetric head counts are not mapped")
+        if hf.get("activation_function", "gelu") != "gelu":
+            raise NotImplementedError(
+                f"whisper activation {hf.get('activation_function')!r} is not mapped"
+            )
+        if hf.get("scale_embedding", False):
+            raise NotImplementedError("whisper scale_embedding=true is not mapped")
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["d_model"],
+            encoder_layers=hf["encoder_layers"],
+            decoder_layers=hf["decoder_layers"],
+            num_heads=hf["encoder_attention_heads"],
+            encoder_ffn_dim=hf["encoder_ffn_dim"],
+            decoder_ffn_dim=hf["decoder_ffn_dim"],
+            num_mel_bins=hf["num_mel_bins"],
+            max_source_positions=hf.get("max_source_positions", 1500),
+            max_target_positions=hf.get("max_target_positions", 448),
+        )
+        fields.update(overrides)
+        return cls(**fields)
+
+
+class _Attention(nn.Module):
+    """Whisper attention: q/v/out biased, k UNbiased, 1/sqrt(d) scale."""
+
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x, kv, mask=None):
+        cfg = self.config
+        d = cfg.d_model // cfg.num_heads
+        dense = lambda name, bias: nn.Dense(
+            cfg.d_model, use_bias=bias, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+        )
+        b, q_len, _ = x.shape
+        k_len = kv.shape[1]
+        q = dense("q_proj", True)(x).reshape(b, q_len, cfg.num_heads, d)
+        k = dense("k_proj", False)(kv).reshape(b, k_len, cfg.num_heads, d)
+        v = dense("v_proj", True)(kv).reshape(b, k_len, cfg.num_heads, d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (d ** -0.5)
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, q_len, cfg.d_model)
+        return dense("out_proj", True)(out)
+
+
+class _FF(nn.Module):
+    config: WhisperConfig
+    ffn_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(self.ffn_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="fc1")(x)
+        h = nn.gelu(h, approximate=False)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        name="fc2")(h)
+
+
+def _norm(cfg: WhisperConfig, name: str):
+    return _LayerNorm(cfg.layer_norm_eps, cfg.param_dtype, name=name)
+
+
+class WhisperEncoderLayer(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = _norm(cfg, "attn_norm")(x)
+        x = x + _Attention(cfg, name="self_attn")(h, h)
+        h = _norm(cfg, "ff_norm")(x)
+        return x + _FF(cfg, cfg.encoder_ffn_dim, name="ff")(h)
+
+
+class WhisperDecoderLayer(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, y, enc_out, causal_mask):
+        cfg = self.config
+        h = _norm(cfg, "attn_norm")(y)
+        y = y + _Attention(cfg, name="self_attn")(h, h, mask=causal_mask)
+        h = _norm(cfg, "cross_norm")(y)
+        y = y + _Attention(cfg, name="cross_attn")(h, enc_out)
+        h = _norm(cfg, "ff_norm")(y)
+        return y + _FF(cfg, cfg.decoder_ffn_dim, name="ff")(h)
+
+
+class Whisper(nn.Module):
+    """``__call__(features [B, frames, n_mels], decoder_input_ids [B, T])
+    -> logits [B, T, V]`` — features are NWC (transpose torch's
+    ``[B, n_mels, frames]`` input); frames must be
+    ``2 * max_source_positions`` (the stride-2 conv halves them)."""
+
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, features, decoder_input_ids):
+        cfg = self.config
+        conv = lambda name, stride: nn.Conv(
+            cfg.d_model, (3,), strides=(stride,), padding=1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name,
+        )
+        if features.shape[1] != 2 * cfg.max_source_positions:
+            # exact raw-frame check, matching HF's WhisperEncoder (an
+            # off-by-one truncated mel batch must not pass via conv rounding)
+            raise ValueError(
+                f"whisper encoder expects exactly {2 * cfg.max_source_positions} "
+                f"input frames ({cfg.max_source_positions} after the stride-2 "
+                f"conv), got {features.shape[1]}"
+            )
+        x = nn.gelu(conv("conv1", 1)(features), approximate=False)
+        x = nn.gelu(conv("conv2", 2)(x), approximate=False)
+        # fixed sinusoids, stored as a (loaded) table like HF does
+        enc_pos = self.param(
+            "encoder_positions", nn.initializers.normal(0.02),
+            (cfg.max_source_positions, cfg.d_model), cfg.param_dtype,
+        )
+        x = x + enc_pos[None].astype(x.dtype)
+        for i in range(cfg.encoder_layers):
+            x = WhisperEncoderLayer(cfg, name=f"encoder_layers_{i}")(x)
+        enc_out = _norm(cfg, "encoder_norm")(x)
+
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="embed_tokens",
+        )
+        t = decoder_input_ids.shape[1]
+        if t > cfg.max_target_positions:
+            raise ValueError(
+                f"decoder_input_ids length {t} exceeds max_target_positions "
+                f"{cfg.max_target_positions}"
+            )
+        dec_pos = self.param(
+            "decoder_positions", nn.initializers.normal(0.02),
+            (cfg.max_target_positions, cfg.d_model), cfg.param_dtype,
+        )
+        y = embed(decoder_input_ids) + dec_pos[None, :t].astype(cfg.dtype)
+        causal = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0,
+            jnp.finfo(jnp.float32).min,
+        )[None, None]
+        for i in range(cfg.decoder_layers):
+            y = WhisperDecoderLayer(cfg, name=f"decoder_layers_{i}")(y, enc_out, causal)
+        y = _norm(cfg, "decoder_norm")(y)
+        logits = embed.attend(y.astype(cfg.param_dtype))  # proj_out tied
+        return logits.astype(jnp.float32)
+
+
+# --------------------------------------------------------------- HF interop
+from .hf_compat import _ident, _t  # noqa: E402  (shared torch-layout transforms)
+
+
+def _conv1d_t(x: np.ndarray) -> np.ndarray:
+    """torch Conv1d [out, in, k] → flax [k, in, out]."""
+    return np.ascontiguousarray(np.transpose(x, (2, 1, 0)))
+
+
+def whisper_key_map(cfg: WhisperConfig) -> Dict[str, Tuple[str, Any]]:
+    m: Dict[str, Tuple[str, Any]] = {
+        "conv1.kernel": ("model.encoder.conv1.weight", _conv1d_t),
+        "conv1.bias": ("model.encoder.conv1.bias", _ident),
+        "conv2.kernel": ("model.encoder.conv2.weight", _conv1d_t),
+        "conv2.bias": ("model.encoder.conv2.bias", _ident),
+        "encoder_positions": ("model.encoder.embed_positions.weight", _ident),
+        "decoder_positions": ("model.decoder.embed_positions.weight", _ident),
+        "embed_tokens.embedding": ("model.decoder.embed_tokens.weight", _ident),
+        "encoder_norm.scale": ("model.encoder.layer_norm.weight", _ident),
+        "encoder_norm.bias": ("model.encoder.layer_norm.bias", _ident),
+        "decoder_norm.scale": ("model.decoder.layer_norm.weight", _ident),
+        "decoder_norm.bias": ("model.decoder.layer_norm.bias", _ident),
+    }
+
+    def attn(native, hf):
+        m[f"{native}.q_proj.kernel"] = (f"{hf}.q_proj.weight", _t)
+        m[f"{native}.q_proj.bias"] = (f"{hf}.q_proj.bias", _ident)
+        m[f"{native}.k_proj.kernel"] = (f"{hf}.k_proj.weight", _t)  # no bias
+        m[f"{native}.v_proj.kernel"] = (f"{hf}.v_proj.weight", _t)
+        m[f"{native}.v_proj.bias"] = (f"{hf}.v_proj.bias", _ident)
+        m[f"{native}.out_proj.kernel"] = (f"{hf}.out_proj.weight", _t)
+        m[f"{native}.out_proj.bias"] = (f"{hf}.out_proj.bias", _ident)
+
+    def block(native, hf, cross: bool):
+        attn(f"{native}.self_attn", f"{hf}.self_attn")
+        m[f"{native}.attn_norm.scale"] = (f"{hf}.self_attn_layer_norm.weight", _ident)
+        m[f"{native}.attn_norm.bias"] = (f"{hf}.self_attn_layer_norm.bias", _ident)
+        if cross:
+            attn(f"{native}.cross_attn", f"{hf}.encoder_attn")
+            m[f"{native}.cross_norm.scale"] = (f"{hf}.encoder_attn_layer_norm.weight", _ident)
+            m[f"{native}.cross_norm.bias"] = (f"{hf}.encoder_attn_layer_norm.bias", _ident)
+        for fc in ("fc1", "fc2"):
+            m[f"{native}.ff.{fc}.kernel"] = (f"{hf}.{fc}.weight", _t)
+            m[f"{native}.ff.{fc}.bias"] = (f"{hf}.{fc}.bias", _ident)
+        m[f"{native}.ff_norm.scale"] = (f"{hf}.final_layer_norm.weight", _ident)
+        m[f"{native}.ff_norm.bias"] = (f"{hf}.final_layer_norm.bias", _ident)
+
+    for i in range(cfg.encoder_layers):
+        block(f"encoder_layers_{i}", f"model.encoder.layers.{i}", cross=False)
+    for i in range(cfg.decoder_layers):
+        block(f"decoder_layers_{i}", f"model.decoder.layers.{i}", cross=True)
+    return m
+
+
+def load_hf_whisper(checkpoint: str, dtype=None, **config_overrides):
+    """HF ``whisper-*`` snapshot dir → ``(Whisper, params)`` (tied
+    ``proj_out`` rides the embedding; shards stream one tensor at a time)."""
+    from ..utils.modeling import unflatten_tree
+    from .hf_compat import stream_mapped_tensors
+
+    with open(os.path.join(checkpoint, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if hf_cfg.get("model_type") != "whisper":
+        raise ValueError(f"{checkpoint} is not a whisper checkpoint")
+    cfg = WhisperConfig.from_hf(hf_cfg, **config_overrides)
+    flat = stream_mapped_tensors(checkpoint, whisper_key_map(cfg), dtype=dtype)
+    return Whisper(cfg), unflatten_tree(flat)

@@ -859,6 +859,55 @@ class TestRobertaParity:
         )
 
 
+class TestWhisperParity:
+    """Speech encoder-decoder: gelu'd stride-2 conv frontend (NWC weight
+    transpose), fixed sinusoid table, k-biasless attention, cross-attention,
+    tied proj_out."""
+
+    def test_logits_match_torch(self, tmp_path):
+        from accelerate_tpu.models.whisper import load_hf_whisper
+
+        cfg = transformers.WhisperConfig(
+            vocab_size=96, d_model=32, encoder_layers=2, decoder_layers=2,
+            encoder_attention_heads=4, decoder_attention_heads=4,
+            encoder_ffn_dim=48, decoder_ffn_dim=48, num_mel_bins=8,
+            max_source_positions=16, max_target_positions=24,
+            dropout=0.0, attention_dropout=0.0, activation_dropout=0.0,
+            pad_token_id=0, bos_token_id=1, eos_token_id=2,
+            decoder_start_token_id=1,
+        )
+        torch.manual_seed(28)
+        model = transformers.WhisperForConditionalGeneration(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        native, params = load_hf_whisper(str(tmp_path))
+        rng = np.random.default_rng(28)
+        feats = rng.standard_normal((2, 8, 32)).astype(np.float32)  # [B, mel, T]
+        dec = rng.integers(3, 96, size=(2, 9)).astype(np.int64)
+        ours = native.apply(
+            {"params": params}, jnp.asarray(np.transpose(feats, (0, 2, 1))),
+            jnp.asarray(dec),
+        )
+        with torch.no_grad():
+            ref = model(
+                input_features=torch.from_numpy(feats),
+                decoder_input_ids=torch.from_numpy(dec),
+            ).logits.float().numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4, atol=4e-4)
+
+    def test_wrong_frame_count_raises(self, tmp_path):
+        from accelerate_tpu.models.whisper import Whisper, WhisperConfig
+
+        cfg = WhisperConfig(vocab_size=96, d_model=32, encoder_layers=1,
+                            decoder_layers=1, num_heads=4, encoder_ffn_dim=48,
+                            decoder_ffn_dim=48, num_mel_bins=8,
+                            max_source_positions=16, max_target_positions=24)
+        model = Whisper(cfg)
+        with pytest.raises(ValueError, match="frames"):
+            model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 20, 8), jnp.float32),
+                       jnp.zeros((1, 4), jnp.int32))
+
+
 class TestViTParity:
     """Vision-transformer family: conv patch embedding (NCHW->NHWC weight
     transpose), CLS token, learned positions, pre-LN blocks."""
