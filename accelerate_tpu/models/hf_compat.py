@@ -47,6 +47,7 @@ __all__ = [
 SUPPORTED_MODEL_TYPES = (
     "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
     "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral", "phi", "bloom",
+    "codegen",
 )
 
 
@@ -281,6 +282,33 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             # worst-case per-expert load is N tokens = factor E/k in
             # resolved_expert_capacity's N*k/E share
             expert_capacity_factor=hf["num_local_experts"] / k,
+        )
+    elif model_type == "codegen":
+        # CodeGen (Salesforce): the GPT-J recipe — shared-norm parallel
+        # residual, interleaved partial rotary, biasless attention, biased
+        # MLP and lm_head — with a tensor-parallel-sharded fused qkv
+        # (mp_num=4 groups in q|v|k order, split in the key map)
+        if hf.get("activation_function", "gelu_new") not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise NotImplementedError(
+                f"codegen activation {hf['activation_function']!r} is not mapped"
+            )
+        if hf["n_head"] % 4:
+            raise NotImplementedError(
+                "codegen n_head must be divisible by the fixed mp_num=4 qkv grouping"
+            )
+        fields = dict(
+            _gpt2_base_fields(hf),
+            max_seq_len=hf.get("n_positions", 2048),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            use_bias=False,
+            positional="rope",
+            rope_interleaved=True,
+            rope_dim=hf.get("rotary_dim"),
+            parallel_residual=True,
+            shared_norm=True,
+            attn_bias=False,
+            mlp_bias=True,
+            lm_head_bias=True,
         )
     elif model_type == "bloom":
         # BLOOM: alibi positions (no positional params), LayerNorm directly
@@ -807,6 +835,33 @@ def bigcode_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     return m
 
 
+def _codegen_qkv_split(cfg: TransformerConfig, which: int) -> Callable:
+    """CodeGen's fused qkv: rows form mp_num=4 groups, each group stacking
+    its share of q, then V, then K (the q|v|k order is CodeGen's quirk).
+    ``which``: 0=q, 1=v, 2=k."""
+    hidden = cfg.hidden_size
+    local = hidden // 4
+
+    def f(x: np.ndarray) -> np.ndarray:
+        g = x.reshape(4, 3, local, x.shape[-1])  # [mp, (q,v,k), local, in]
+        return _t(g[:, which].reshape(hidden, x.shape[-1]))
+
+    return f
+
+
+def codegen_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """CodeGen naming: GPT-J's tree verbatim except the fused qkv — reuse
+    :func:`gptj_key_map` and overwrite the three attention input
+    projections with the mp_num-grouped split."""
+    m = gptj_key_map(cfg)
+    for i in range(cfg.num_layers):
+        n, qkv = f"layers_{i}", f"transformer.h.{i}.attn.qkv_proj.weight"
+        m[f"{n}.attn.q_proj.kernel"] = (qkv, _codegen_qkv_split(cfg, 0))
+        m[f"{n}.attn.v_proj.kernel"] = (qkv, _codegen_qkv_split(cfg, 1))
+        m[f"{n}.attn.k_proj.kernel"] = (qkv, _codegen_qkv_split(cfg, 2))
+    return m
+
+
 def bloom_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     """BLOOM naming (``transformer.h.{i}.self_attention...``): head-major
     fused qkv (NeoX layout — :func:`_neox_qkv_split` reused), embedding
@@ -916,6 +971,8 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = phi_key_map(cfg)
     elif hf["model_type"] == "bloom":
         mapping = bloom_key_map(cfg)
+    elif hf["model_type"] == "codegen":
+        mapping = codegen_key_map(cfg)
     else:  # llama recipe: llama / mistral / qwen2 / gemma / stablelm
         mapping = llama_key_map(cfg)
     return cfg, mapping

@@ -57,6 +57,12 @@ class WhisperConfig:
             )
         if hf.get("scale_embedding", False):
             raise NotImplementedError("whisper scale_embedding=true is not mapped")
+        if not hf.get("tie_word_embeddings", True):
+            # the module decodes through embed.attend; an untied proj_out
+            # would be silently dropped by the key map
+            raise NotImplementedError(
+                "whisper tie_word_embeddings=false (untied proj_out) is not mapped"
+            )
         fields = dict(
             vocab_size=hf["vocab_size"],
             d_model=hf["d_model"],

@@ -748,6 +748,35 @@ class TestT5Parity:
         self._assert_parity(tmp_path, model)
 
 
+class TestCodeGenParity:
+    """CodeGen: GPT-J recipe with the mp_num=4 grouped fused qkv in q|v|k
+    order — 8 heads puts 2 heads per mp group, exercising the reorder."""
+
+    def test_logits_match_torch(self, tmp_path):
+        cfg = transformers.CodeGenConfig(
+            vocab_size=96, n_embd=64, n_layer=2, n_head=8, rotary_dim=4,
+            n_positions=64, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+        torch.manual_seed(29)
+        model = transformers.CodeGenForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        ncfg = config_from_hf(str(tmp_path))
+        assert ncfg.parallel_residual and ncfg.shared_norm
+        assert ncfg.rope_interleaved and ncfg.rope_dim == 4
+        assert ncfg.attn_bias is False and ncfg.mlp_bias is True and ncfg.lm_head_bias
+        rng = np.random.default_rng(29)
+        ids = rng.integers(0, 96, size=(2, 14)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_head_count_guard(self):
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        with pytest.raises(NotImplementedError, match="mp_num"):
+            _config_from_hf_dict(dict(model_type="codegen", vocab_size=96,
+                                      n_embd=64, n_layer=1, n_head=6))
+
+
 class TestBloomParity:
     """BLOOM: alibi positions (6 heads exercises the non-power-of-2 slope
     correction), embedding LayerNorm, head-major fused qkv, tied head."""
@@ -893,6 +922,19 @@ class TestWhisperParity:
                 decoder_input_ids=torch.from_numpy(dec),
             ).logits.float().numpy()
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4, atol=4e-4)
+
+    def test_untied_proj_out_rejected(self):
+        """tie_word_embeddings=false would silently drop proj_out — must
+        raise instead."""
+        from accelerate_tpu.models.whisper import WhisperConfig
+
+        with pytest.raises(NotImplementedError, match="tie_word_embeddings"):
+            WhisperConfig.from_hf(dict(
+                vocab_size=96, d_model=32, encoder_layers=1, decoder_layers=1,
+                encoder_attention_heads=4, decoder_attention_heads=4,
+                encoder_ffn_dim=48, decoder_ffn_dim=48, num_mel_bins=8,
+                tie_word_embeddings=False,
+            ))
 
     def test_wrong_frame_count_raises(self, tmp_path):
         from accelerate_tpu.models.whisper import Whisper, WhisperConfig
