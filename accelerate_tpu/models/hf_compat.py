@@ -47,7 +47,7 @@ __all__ = [
 SUPPORTED_MODEL_TYPES = (
     "gpt2", "llama", "opt", "gptj", "gpt_neox", "mistral", "qwen2", "gemma",
     "phi3", "falcon", "stablelm", "gpt_bigcode", "mixtral", "phi", "bloom",
-    "codegen",
+    "codegen", "mpt",
 )
 
 
@@ -282,6 +282,49 @@ def _config_from_hf_dict(hf: Dict[str, Any], **overrides) -> TransformerConfig:
             # worst-case per-expert load is N tokens = factor E/k in
             # resolved_expert_capacity's N*k/E share
             expert_capacity_factor=hf["num_local_experts"] / k,
+        )
+    elif model_type == "mpt":
+        # MPT (MosaicML): alibi positions, no_bias scale-only LayerNorms,
+        # plain-order fused Wqkv, erf-gelu MLP, tied head.  For power-of-2
+        # head counts at the default alibi_bias_max=8, MPT's slope sequence
+        # equals the Press et al. slopes the alibi path computes; the
+        # non-power-of-2 interleave differs, so it is rejected.
+        attn = hf.get("attn_config") or {}
+        if not attn.get("alibi", True):
+            raise NotImplementedError("mpt without alibi is not mapped")
+        if attn.get("alibi_bias_max", 8) != 8:
+            raise NotImplementedError("mpt alibi_bias_max != 8 is not mapped")
+        if attn.get("qk_ln", False):
+            raise NotImplementedError("mpt qk_ln=true is not mapped")
+        if attn.get("clip_qkv"):
+            raise NotImplementedError("mpt clip_qkv is not mapped")
+        if attn.get("softmax_scale") is not None:
+            raise NotImplementedError("mpt custom softmax_scale is not mapped")
+        n_heads = hf["n_heads"]
+        if n_heads & (n_heads - 1):
+            raise NotImplementedError(
+                "mpt non-power-of-2 head counts use a different alibi-slope "
+                "interleave and are not mapped"
+            )
+        if not hf.get("no_bias", True):
+            raise NotImplementedError("mpt no_bias=false (biased variant) is not mapped")
+        fields = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["d_model"],
+            # transformers' MptMLP hardcodes 4*d_model and IGNORES the
+            # config's expansion_ratio — parity targets the HF port
+            intermediate_size=4 * hf["d_model"],
+            num_layers=hf["n_layers"],
+            num_heads=n_heads,
+            num_kv_heads=n_heads,
+            max_seq_len=hf.get("max_seq_len", 2048),
+            rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=True,  # lm_head is tied to wte
+            norm_type="layernorm",
+            norm_bias=False,
+            use_bias=False,
+            positional="alibi",
+            mlp_variant="gelu_exact",
         )
     elif model_type == "codegen":
         # CodeGen (Salesforce): the GPT-J recipe — shared-norm parallel
@@ -862,6 +905,31 @@ def codegen_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     return m
 
 
+def mpt_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
+    """MPT naming (``transformer.blocks.{i}...``): scale-only norms, fused
+    plain-order Wqkv (q|k|v row blocks), biasless projections, tied head."""
+    hd = cfg.resolved_head_dim
+    e = cfg.num_heads * hd
+    m: Dict[str, Tuple[str, Callable]] = {
+        "embed_tokens.embedding": ("transformer.wte.weight", _ident),
+        "final_norm.scale": ("transformer.norm_f.weight", _ident),
+    }
+    for i in range(cfg.num_layers):
+        n, h = f"layers_{i}", f"transformer.blocks.{i}"
+        qkv = f"{h}.attn.Wqkv.weight"
+        m.update({
+            f"{n}.input_norm.scale": (f"{h}.norm_1.weight", _ident),
+            f"{n}.post_attn_norm.scale": (f"{h}.norm_2.weight", _ident),
+            f"{n}.attn.q_proj.kernel": (qkv, _rows(0, e)),
+            f"{n}.attn.k_proj.kernel": (qkv, _rows(e, 2 * e)),
+            f"{n}.attn.v_proj.kernel": (qkv, _rows(2 * e, 3 * e)),
+            f"{n}.attn.o_proj.kernel": (f"{h}.attn.out_proj.weight", _t),
+            f"{n}.mlp.up_proj.kernel": (f"{h}.ffn.up_proj.weight", _t),
+            f"{n}.mlp.down_proj.kernel": (f"{h}.ffn.down_proj.weight", _t),
+        })
+    return m
+
+
 def bloom_key_map(cfg: TransformerConfig) -> Dict[str, Tuple[str, Callable]]:
     """BLOOM naming (``transformer.h.{i}.self_attention...``): head-major
     fused qkv (NeoX layout — :func:`_neox_qkv_split` reused), embedding
@@ -973,6 +1041,8 @@ def native_key_map(checkpoint: str, cfg: Optional[TransformerConfig] = None):
         mapping = bloom_key_map(cfg)
     elif hf["model_type"] == "codegen":
         mapping = codegen_key_map(cfg)
+    elif hf["model_type"] == "mpt":
+        mapping = mpt_key_map(cfg)
     else:  # llama recipe: llama / mistral / qwen2 / gemma / stablelm
         mapping = llama_key_map(cfg)
     return cfg, mapping

@@ -105,6 +105,8 @@ class TransformerConfig:
     # norm_type="layernorm" + use_bias=True + positional="learned" +
     # mlp_variant="gelu" + tie_word_embeddings=True.
     norm_type: str = "rmsnorm"         # "rmsnorm" | "layernorm" (centered, with bias)
+    # MPT's no_bias LayerNorms: centered statistics but no bias parameter
+    norm_bias: bool = True
     use_bias: bool = False             # biases on attention/MLP projections
     # "alibi" (BLOOM/MPT): no positional params at all — per-head linear
     # distance penalties added to the attention logits
@@ -420,21 +422,26 @@ class RMSNorm(nn.Module):
 
 
 class LayerNorm(nn.Module):
-    """Centered layernorm with bias (GPT-2 family): fp32 statistics regardless
-    of activation dtype, matching torch ``nn.LayerNorm`` numerics."""
+    """Centered layernorm (GPT-2 family): fp32 statistics regardless of
+    activation dtype, matching torch ``nn.LayerNorm`` numerics.
+    ``use_bias=False`` is MPT's no_bias variant (centered, scale-only)."""
 
     eps: float = 1e-5
     param_dtype: Any = jnp.float32
+    use_bias: bool = True
 
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
-        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), self.param_dtype)
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
-        normed = (xf - mean) * jax.lax.rsqrt(var + self.eps)
-        return (normed * scale + bias).astype(x.dtype)
+        normed = (xf - mean) * jax.lax.rsqrt(var + self.eps) * scale
+        if self.use_bias:
+            normed = normed + self.param(
+                "bias", nn.initializers.zeros, (x.shape[-1],), self.param_dtype
+            )
+        return normed.astype(x.dtype)
 
 
 def make_norm(cfg: "TransformerConfig", name: Optional[str] = None):
@@ -442,7 +449,7 @@ def make_norm(cfg: "TransformerConfig", name: Optional[str] = None):
     final norm, big_modeling's streaming head stage, and the pipeline head
     (``name=None`` for root-level ``.apply``, where flax forbids names)."""
     if cfg.norm_type == "layernorm":
-        return LayerNorm(cfg.rms_norm_eps, cfg.param_dtype, name=name)
+        return LayerNorm(cfg.rms_norm_eps, cfg.param_dtype, cfg.norm_bias, name=name)
     return RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, cfg.norm_unit_offset, name=name)
 
 

@@ -3,7 +3,7 @@
 `device_map="auto"` and generates).
 
 Point this at any snapshot of a mapped family (GPT-2, Llama, OPT, GPT-J,
-GPT-NeoX/Pythia, Mistral, Qwen2, Gemma, Phi-1/2, Phi-3, Falcon, StableLM, Mixtral, BLOOM, CodeGen,
+GPT-NeoX/Pythia, Mistral, Qwen2, Gemma, Phi-1/2, Phi-3, Falcon, StableLM, Mixtral, BLOOM, MPT, CodeGen,
 GPT-BigCode/StarCoder):
 
     python examples/inference/hf_checkpoint_generate.py --checkpoint path/to/gpt2

@@ -748,6 +748,38 @@ class TestT5Parity:
         self._assert_parity(tmp_path, model)
 
 
+class TestMptParity:
+    """MPT: alibi positions (pow-2 heads where MPT's slopes equal Press et
+    al.'s), scale-only no_bias LayerNorms, plain-order fused Wqkv."""
+
+    def test_logits_match_torch(self, tmp_path):
+        cfg = transformers.MptConfig(
+            d_model=64, n_heads=8, n_layers=2, vocab_size=96, max_seq_len=64,
+            expansion_ratio=2, resid_pdrop=0.0, emb_pdrop=0.0,
+        )
+        torch.manual_seed(30)
+        model = transformers.MptForCausalLM(cfg).eval()
+        model.save_pretrained(tmp_path, safe_serialization=True)
+        ncfg = config_from_hf(str(tmp_path))
+        assert ncfg.positional == "alibi" and not ncfg.norm_bias
+        assert not ncfg.use_bias and ncfg.tie_word_embeddings
+        rng = np.random.default_rng(30)
+        ids = rng.integers(0, 96, size=(2, 16)).astype(np.int64)
+        ours = _flax_logits(str(tmp_path), ids)
+        np.testing.assert_allclose(ours, _torch_logits(model, ids), rtol=3e-4, atol=3e-4)
+
+    def test_unmapped_variants_rejected(self):
+        from accelerate_tpu.models.hf_compat import _config_from_hf_dict
+
+        base = dict(model_type="mpt", d_model=64, n_heads=8, n_layers=1, vocab_size=96)
+        with pytest.raises(NotImplementedError, match="power-of-2"):
+            _config_from_hf_dict(dict(base, n_heads=6))
+        with pytest.raises(NotImplementedError, match="clip_qkv"):
+            _config_from_hf_dict(dict(base, attn_config={"alibi": True, "clip_qkv": 8}))
+        with pytest.raises(NotImplementedError, match="alibi"):
+            _config_from_hf_dict(dict(base, attn_config={"alibi": False}))
+
+
 class TestCodeGenParity:
     """CodeGen: GPT-J recipe with the mp_num=4 grouped fused qkv in q|v|k
     order — 8 heads puts 2 heads per mp group, exercising the reorder."""
