@@ -67,6 +67,7 @@ from .ops import (
     QuantizationConfig,
     quantize_model_params,
 )
+from .serving import ServingEngine
 from .local_sgd import LocalSGD
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler

@@ -772,7 +772,7 @@ class Accelerator:
         disk_store = info.get("disk_store")
 
         def base_fn(p):
-            from jax.memory import Space
+            from .utils.jax_compat import Space
 
             # host-resident source params (init_params_on_host) stream in;
             # the unused opt_state computation is dead code XLA eliminates
@@ -795,7 +795,7 @@ class Accelerator:
             orig_pos = {j: k for k, j in enumerate(orig_ids)}
 
             def chunk_init(chunk_leaves, group=group, masked=masked, orig_pos=orig_pos):
-                from jax.memory import Space
+                from .utils.jax_compat import Space
 
                 from .utils.chunked_update import fill_view
 
@@ -952,7 +952,10 @@ class Accelerator:
                     )
                     jitted.clear_cache()
                 return placed
-            except (ValueError, NotImplementedError) as e:  # older runtimes
+            except (ValueError, NotImplementedError, jax.errors.JaxRuntimeError) as e:
+                # older runtimes: trace-time rejection (ValueError /
+                # NotImplementedError) or an XLA compile-time RET_CHECK on
+                # host-placement annotations (JaxRuntimeError)
                 logger.warning_once(
                     f"direct host-memory placement unsupported ({e}); falling back "
                     "to two-phase creation — the full state transiently occupies HBM."
@@ -1281,7 +1284,7 @@ class Accelerator:
                 batch,
             )
             rng_spec = None if sub is None else PartitionSpec()
-            return jax.shard_map(
+            return mesh_lib.shard_map(
                 run,
                 mesh=mesh,
                 axis_names={"dp"},
@@ -1296,7 +1299,7 @@ class Accelerator:
             micro programs — the sync program emits ``avg`` (aliased into the
             donated accumulation buffer) and no ``grad_accum``, the micro
             program the reverse, saving a params-sized buffer each."""
-            from jax.memory import Space
+            from .utils.jax_compat import Space
 
             # Host-offloaded params stream to HBM for the step and back after
             # (ZeRO-offload; reference DeepSpeedPlugin.offload_*_device).  The
@@ -1657,7 +1660,7 @@ class Accelerator:
         def _step(state_or_params, batch):
             params = state_or_params.params if isinstance(state_or_params, TrainState) else state_or_params
             if offload_params:
-                from jax.memory import Space
+                from .utils.jax_compat import Space
 
                 params = jax.device_put(params, Space.Device)
             batch = self._constrain_batch(batch)
@@ -1707,7 +1710,7 @@ class Accelerator:
 
             def _grad(state, batch):
                 if offload_params:
-                    from jax.memory import Space
+                    from .utils.jax_compat import Space
 
                     state = state.replace(params=jax.device_put(state.params, Space.Device))
                 if state.rng is not None:
@@ -1763,7 +1766,7 @@ class Accelerator:
                 if offloading:
                     # Stream host-offloaded leaves to HBM for the update and back
                     # (same round-trip the compiled step does on sync steps).
-                    from jax.memory import Space
+                    from .utils.jax_compat import Space
 
                     if offload_params:
                         state = state.replace(params=jax.device_put(state.params, Space.Device))
@@ -1790,7 +1793,7 @@ class Accelerator:
                 if state.rng is not None:
                     new = new.replace(rng=jax.random.split(state.rng)[0])
                 if offloading:
-                    from jax.memory import Space
+                    from .utils.jax_compat import Space
 
                     if offload_params:
                         new = new.replace(params=jax.device_put(new.params, Space.Host))

@@ -943,13 +943,19 @@ class StreamingTransformer(StreamingExecutor):
         return super().__call__(input_ids, positions)
 
     # -- autoregressive decode (weights stream per token, cache stays in HBM) --
-    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+    def init_cache(self, batch_size: int, max_len: int, dtype=None,
+                   per_lane_index: bool = False):
         """Per-chunk KV caches on the exec device: ``{"chunks": [(ks, vs), ...],
         "index": scalar}`` where ks/vs are per-layer ``[B, max_len, Hkv, D]``.
 
         Unlike the monolithic :class:`~accelerate_tpu.models.transformer.KVCache`
         (stacked over depth), chunk-grained caches keep ONE decode executable
         per chunk size and let each stage carry only its own slice.
+
+        ``per_lane_index=True`` makes ``index`` a ``[B]`` vector — each lane
+        decodes at its own position, the same masked-step contract the
+        continuous-batching slot pool (:mod:`accelerate_tpu.serving`) drives,
+        so a host scheduler can run in-flight admission over streaming weights.
         """
         cfg = self.config
         dtype = dtype if dtype is not None else getattr(cfg, "dtype", jnp.bfloat16)
@@ -960,9 +966,10 @@ class StreamingTransformer(StreamingExecutor):
             ks = tuple(jax.device_put(jnp.zeros(shape, dtype), self.device) for _ in c)
             vs = tuple(jax.device_put(jnp.zeros(shape, dtype), self.device) for _ in c)
             chunks.append((ks, vs))
+        index_shape = (batch_size,) if per_lane_index else ()
         return {
             "chunks": chunks,
-            "index": jax.device_put(jnp.zeros((), jnp.int32), self.device),
+            "index": jax.device_put(jnp.zeros(index_shape, jnp.int32), self.device),
         }
 
     def forward_with_cache(self, input_ids, cache):
@@ -974,7 +981,11 @@ class StreamingTransformer(StreamingExecutor):
             self._stack_cache = None
         index = cache["index"]
         s = input_ids.shape[1]
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], input_ids.shape) + index
+        # scalar index: lockstep decode; [B] per-lane index: each lane at its
+        # own position (the serving masked-step contract — Attention writes
+        # per-lane and cached_attention masks per-lane)
+        offset = index[:, None] if jnp.ndim(index) else index
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], input_ids.shape) + offset
         transfer_cache: Dict[int, Any] = {}
         n = len(self.plan)
         current = self._prepare_stage(0, transfer_cache)
@@ -1030,7 +1041,8 @@ class StreamingTransformer(StreamingExecutor):
         if cache is None:
             cache = self.init_cache(b, s + max_new_tokens)
         else:
-            used = int(jax.device_get(cache["index"]))
+            idx = jax.device_get(cache["index"])
+            used = int(idx.max()) if getattr(idx, "ndim", 0) else int(idx)
             max_len = cache["chunks"][0][0][0].shape[1]
             if used + s + max_new_tokens > max_len:
                 raise ValueError(

@@ -86,6 +86,54 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_tokens_batched(
+    logits: jax.Array,
+    rngs: jax.Array,
+    *,
+    do_sample: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-lane sampling: ``[N, V] logits`` + per-lane knob *vectors* -> ``[N]``
+    int32 tokens.  The serving engine's analog of :func:`sample_tokens`: one
+    executable serves every mix of per-request configs currently occupying the
+    slot pool (static knobs would force a retrace per config combination).
+
+    ``rngs`` is ``[N, 2]`` uint32 (one key per lane); ``do_sample`` bool [N];
+    ``temperature`` f32 [N]; ``top_k`` int32 [N] (``<= 0`` disables); ``top_p``
+    f32 [N] (``>= 1`` disables).  Greedy lanes take ``argmax`` — bitwise the
+    same decision :func:`sample_tokens` makes, which is what keeps the
+    continuous-batching path token-exact vs ``generate`` for greedy requests.
+    """
+    v = logits.shape[-1]
+    neg_inf = jnp.finfo(jnp.float32).min
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    use_sample = do_sample & (temperature > 0.0)
+
+    def _sampled(_):
+        lf = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
+        # top-k: kth-largest per lane via one sort; lanes with top_k <= 0 keep all
+        sorted_desc = jnp.sort(lf, axis=-1)[:, ::-1]
+        kidx = jnp.clip(top_k, 1, v) - 1
+        kth = jnp.take_along_axis(sorted_desc, kidx[:, None], axis=-1)
+        lf = jnp.where((top_k > 0)[:, None] & (lf < kth), neg_inf, lf)
+        # top-p on the (possibly top-k-filtered) logits — same filter order as
+        # sample_tokens; second sort because the k-filter changed the tail
+        sorted_p = jnp.sort(lf, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_p, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        outside = (cum - probs) >= top_p[:, None]
+        min_kept = jnp.min(jnp.where(outside, jnp.inf, sorted_p), axis=-1, keepdims=True)
+        lf = jnp.where((top_p < 1.0)[:, None] & (lf < min_kept), neg_inf, lf)
+        sampled = jax.vmap(lambda r, row: jax.random.categorical(r, row))(rngs, lf)
+        return jnp.where(use_sample, sampled.astype(jnp.int32), greedy)
+
+    # two full-vocab sorts per token are pure waste while every occupied lane
+    # is greedy (the common serving mix) — branch at runtime, not trace time
+    return jax.lax.cond(jnp.any(use_sample), _sampled, lambda _: greedy, None)
+
+
 @functools.lru_cache(maxsize=32)
 def make_sampler(do_sample: bool = False, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None):
@@ -194,8 +242,10 @@ def generate(
         cache = KVCache.create(model.config, b, total)
     else:
         # account for already-written entries: dynamic_update_slice CLAMPS
-        # out-of-range writes, which would silently corrupt the cache
-        used = int(jax.device_get(cache.index))
+        # out-of-range writes, which would silently corrupt the cache.  A
+        # per-lane index (serving pool) bounds by its furthest lane.
+        idx = jax.device_get(cache.index)
+        used = int(idx.max()) if getattr(idx, "ndim", 0) else int(idx)
         if used + total > cache.max_len:
             raise ValueError(
                 f"cache max_len {cache.max_len} < {used} already written + prompt {s} "

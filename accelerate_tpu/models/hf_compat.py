@@ -1078,23 +1078,24 @@ def stream_mapped_tensors(checkpoint: str, mapping: Dict[str, Tuple[str, Callabl
 
     The shared loader core behind :func:`~.bert.load_hf_bert` and
     :func:`~.t5.load_hf_t5` (``convert_hf_checkpoint`` keeps its own loop —
-    it additionally shards to disk and fans one HF tensor out to several
-    natives).  Unmapped HF keys (tied duplicates, buffer caches) are
-    skipped; missing mapped tensors raise.
+    it additionally shards to disk).  Fan-out is supported: several native
+    keys may cite the SAME HF tensor (tied embeddings, fused qkv splits),
+    each through its own transform.  Unmapped HF keys (tied duplicates,
+    buffer caches) are skipped; missing mapped tensors raise.
     """
     import jax.numpy as jnp
 
-    by_hf: Dict[str, Tuple[str, Callable]] = {
-        hf_key: (native, transform) for native, (hf_key, transform) in mapping.items()
-    }
+    # one HF tensor may feed several natives — invert to a multimap (a plain
+    # dict comprehension would keep only the last native and misreport the
+    # rest as "missing tensors")
+    by_hf: Dict[str, list] = {}
+    for native, (hf_key, transform) in mapping.items():
+        by_hf.setdefault(hf_key, []).append((native, transform))
     flat: Dict[str, np.ndarray] = {}
     for hf_key, tensor in _iter_hf_tensors(checkpoint):
-        target = by_hf.get(hf_key)
-        if target is None:
-            continue
-        native, transform = target
-        t = transform(tensor)
-        flat[native] = t.astype(jnp.dtype(dtype)) if dtype is not None else t
+        for native, transform in by_hf.get(hf_key, ()):
+            t = transform(tensor)
+            flat[native] = t.astype(jnp.dtype(dtype)) if dtype is not None else t
     missing = set(mapping) - set(flat)
     if missing:
         raise ValueError(f"{checkpoint} is missing tensors for {sorted(missing)[:5]}")

@@ -267,15 +267,21 @@ class KVCache(struct.PyTreeNode):
     head_dim]`` — written in place with ``lax.dynamic_update_slice`` at a
     traced position index, so ONE decode executable serves every token and XLA
     aliases the update when the cache is donated.
+
+    ``index`` is either a scalar (the whole batch decodes in lockstep — the
+    ``generate`` path) or a per-lane ``[B]`` vector (each lane sits at its own
+    position — the continuous-batching slot pool of
+    :mod:`accelerate_tpu.serving`, where a "lane" is a request slot).  Writes
+    and attention masking follow whichever form is present.
     """
 
     k: jax.Array            # [L, B, max_len, n_kv_heads, head_dim]
     v: jax.Array            # [L, B, max_len, n_kv_heads, head_dim]
-    index: jax.Array        # scalar int32: next write position (= tokens seen)
+    index: jax.Array        # int32 next write position: scalar, or [B] per lane
 
     @classmethod
     def create(cls, config: "TransformerConfig", batch_size: int, max_len: Optional[int] = None,
-               dtype: Any = None) -> "KVCache":
+               dtype: Any = None, per_lane_index: bool = False) -> "KVCache":
         max_len = max_len if max_len is not None else config.max_seq_len
         shape = (config.num_layers, batch_size, max_len,
                  config.num_kv_heads, config.resolved_head_dim)
@@ -283,7 +289,7 @@ class KVCache(struct.PyTreeNode):
         return cls(
             k=jnp.zeros(shape, dtype),
             v=jnp.zeros(shape, dtype),
-            index=jnp.zeros((), jnp.int32),
+            index=jnp.zeros((batch_size,) if per_lane_index else (), jnp.int32),
         )
 
     @property
@@ -482,12 +488,22 @@ class Attention(nn.Module):
             k = _apply_rope(k, positions, cfg)
         if cache is not None:
             k_cache, v_cache, index = cache
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, index, 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, index, 0, 0)
-            )
+            if jnp.ndim(index) == 0:
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype), (0, index, 0, 0)
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype), (0, index, 0, 0)
+                )
+            else:
+                # per-lane index [B] (serving slot pool): every lane writes at
+                # its own position — vmap the slice update over the batch (XLA
+                # lowers it to a scatter; still a single executable)
+                def _write(c, u, i):
+                    return jax.lax.dynamic_update_slice(c, u, (i, 0, 0))
+
+                k_cache = jax.vmap(_write)(k_cache, k.astype(k_cache.dtype), index)
+                v_cache = jax.vmap(_write)(v_cache, v.astype(v_cache.dtype), index)
             out = cached_attention(q, k_cache, v_cache, positions,
                                    window=cfg.sliding_window,
                                    alibi=cfg.positional == "alibi")
@@ -622,7 +638,10 @@ class Transformer(nn.Module):
                 jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
             )
             if cache is not None:
-                positions = positions + cache.index
+                idx = cache.index
+                # scalar index: whole batch at one position; [B] per-lane index
+                # (serving slot pool): each lane offset by its own length
+                positions = positions + (idx[:, None] if jnp.ndim(idx) else idx)
         embed = nn.Embed(
             cfg.vocab_size,
             cfg.hidden_size,

@@ -49,7 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import mesh_axis_size, present_data_axes
+from .mesh import mesh_axis_size, present_data_axes, shard_map
 
 
 def schedule_slots(schedule: str, num_microbatches: int, n_stages: int) -> int:
@@ -189,7 +189,7 @@ def pipeline_apply(
     # aux scalars come back replicated: psum over pp + pmean over data axes
     # happen inside the worker
     out_specs = (mb_spec, P()) if carries_aux else mb_spec
-    return jax.shard_map(
+    return shard_map(
         worker,
         mesh=mesh,
         in_specs=(param_specs, mb_spec) + (barg_spec,) * n_bargs,
@@ -573,7 +573,7 @@ def _pipeline_1f1b_lm_loss(model, mesh, num_microbatches, axis):
         stack_specs = jax.tree_util.tree_map(lambda _: P(axis), stack)
         rep = P()
         mb_spec = P(None, data) if data else P()
-        loss, g_stack, g_embed, g_head = jax.shard_map(
+        loss, g_stack, g_embed, g_head = shard_map(
             worker,
             mesh=mesh_r,
             in_specs=(stack_specs, rep, rep, mb_spec, mb_spec),
@@ -654,6 +654,21 @@ def prepare_pipeline(
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b_p // M, s))
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         x = scale_embed(cfg, embed.apply({"params": p["embed_tokens"]}, input_ids))
+        # full embed recipe, same order as the monolithic forward
+        # (models/transformer.py): scale -> embed_norm (BLOOM) -> learned
+        # position table (GPT-2/OPT) — previously these silently dropped,
+        # diverging pipeline inference for those families
+        if getattr(cfg, "embed_norm", False):
+            x = make_norm(cfg).apply({"params": p["embed_norm"]}, x)
+        if getattr(cfg, "positional", "rope") == "learned":
+            offset = getattr(cfg, "pos_offset", 0)
+            pos_embed = nn.Embed(
+                cfg.max_seq_len + offset, cfg.hidden_size,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            )
+            x = x + pos_embed.apply(
+                {"params": p["pos_embed"]}, jnp.arange(s)[None, :] + offset
+            )
         mbs = x.reshape(M, b_p // M, s, cfg.hidden_size)
         layer_params = stack_layer_params(p, cfg.num_layers)
         out = pipeline_apply(

@@ -344,6 +344,7 @@ def ring_attention_sharded(
     shard_map and skip them.
     """
     from .mesh import DATA_AXES
+    from .mesh import shard_map as _shard_map_compat
 
     if batch_axes is None:
         batch_axes = DATA_AXES
@@ -372,7 +373,7 @@ def ring_attention_sharded(
         inner, axis_name=axis_name, causal=causal, scale=scale, remat=remat
     )
     if segment_ids is not None:
-        wrapped = jax.shard_map(
+        wrapped = _shard_map_compat(
             lambda q, k, v, s: fn(q, k, v, segment_ids=s),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
@@ -381,7 +382,7 @@ def ring_attention_sharded(
         )
         out = wrapped(q, k, v, segment_ids)
     else:
-        wrapped = jax.shard_map(
+        wrapped = _shard_map_compat(
             lambda q, k, v: fn(q, k, v),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),

@@ -1,0 +1,26 @@
+"""Continuous-batching serving: slot-based KV pool, in-flight admission,
+chunked prefill — iteration-level scheduling (Orca; vLLM's slot reuse) kept
+inside a fixed set of compiled TPU executables.  See ``docs/usage/serving.md``.
+"""
+
+from .engine import ServingEngine
+from .pool import (
+    jit_cache_sizes,
+    make_decode_window,
+    make_insert,
+    make_prefill_chunk,
+    plan_chunks,
+)
+from .scheduler import Request, RequestState, Scheduler
+
+__all__ = [
+    "ServingEngine",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "plan_chunks",
+    "make_decode_window",
+    "make_prefill_chunk",
+    "make_insert",
+    "jit_cache_sizes",
+]

@@ -1,0 +1,330 @@
+"""Continuous-batching serving engine over the slot-based KV pool.
+
+The static ``generate`` path is one whole-batch program: every request starts
+together and runs exactly ``max_new_tokens`` steps, so at mixed request
+lengths the batch's tokens/s collapses to the longest request's schedule.
+:class:`ServingEngine` instead runs iteration-level scheduling (Orca-style)
+against a fixed set of compiled executables (:mod:`.pool`):
+
+1. a request queue admits FCFS into freed slots, prefilling chunked under a
+   per-step token budget (:mod:`.scheduler`);
+2. a masked decode window advances every occupied slot; EOS or the length cap
+   frees a slot the same step it fires;
+3. freed slots are reused by queued requests without disturbing running lanes.
+
+Everything dynamic lives on the host; the device only ever sees
+``1 + len(prefill_buckets) + 1`` shapes (decode window, per-bucket prefill,
+insert).  See ``docs/usage/serving.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generation import GenerationConfig
+from ..models.transformer import KVCache, Transformer
+from .pool import jit_cache_sizes, make_decode_window, make_insert, make_prefill_chunk
+from .scheduler import Request, RequestState, Scheduler
+
+
+class ServingEngine:
+    """Serve many requests through one slot pool with in-flight admission.
+
+    Parameters
+    ----------
+    model, params: the flagship ``Transformer`` and its (HBM-resident) params.
+    num_slots: concurrent request lanes in the KV pool.
+    max_len: per-slot KV capacity (default ``config.max_seq_len``).  A request
+        needs ``prompt_len + max_new_tokens + decode_window <= max_len``.
+    prefill_buckets: fixed chunk sizes for chunked prefill — one compiled
+        prefill shape per bucket.  Defaults to ``(128, 512)`` clipped to
+        ``max_prompt_len``.
+    max_prompt_len: scratch-cache capacity (longest admissible prompt);
+        defaults to ``max_len``.
+    prefill_token_budget: max prefill tokens charged per engine step (bounds
+        decode-latency jitter while prompts stream in); default: the largest
+        bucket.
+    decode_window: decode steps fused per engine step (one ``lax.scan``
+        executable).  Larger windows amortize host round-trips; a request
+        finishing mid-window wastes at most ``window - 1`` masked lane-steps.
+    slot_order: optional slot-id preference for admission (tests permute this
+        to pin down lane independence).
+    """
+
+    def __init__(
+        self,
+        model: Transformer,
+        params: Any,
+        num_slots: int = 4,
+        max_len: Optional[int] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        max_prompt_len: Optional[int] = None,
+        prefill_token_budget: Optional[int] = None,
+        decode_window: int = 4,
+        pad_token_id: int = 0,
+        rng_seed: int = 0,
+        slot_order: Optional[Sequence[int]] = None,
+    ):
+        cfg = model.config
+        self.model = model
+        self.params = params
+        self.config = cfg
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len if max_len is not None else cfg.max_seq_len)
+        self.max_prompt_len = int(
+            max_prompt_len if max_prompt_len is not None else self.max_len
+        )
+        if self.max_prompt_len > self.max_len:
+            raise ValueError(
+                f"max_prompt_len {self.max_prompt_len} > slot capacity {self.max_len}"
+            )
+        if prefill_buckets is None:
+            prefill_buckets = [b for b in (128, 512) if b <= self.max_prompt_len]
+            if not prefill_buckets:
+                prefill_buckets = [self.max_prompt_len]
+        self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
+        if self.buckets[-1] > self.max_prompt_len:
+            raise ValueError(
+                f"largest prefill bucket {self.buckets[-1]} exceeds "
+                f"max_prompt_len {self.max_prompt_len}"
+            )
+        self.window = int(decode_window)
+        self.pad_token_id = int(pad_token_id)
+        if slot_order is None:
+            slot_order = range(self.num_slots)
+        self.slot_order = tuple(int(s) for s in slot_order)
+        if sorted(self.slot_order) != list(range(self.num_slots)):
+            raise ValueError(
+                f"slot_order must permute range({self.num_slots}), got {self.slot_order}"
+            )
+
+        # device state: the pool (per-lane index) + the batch-1 prefill scratch
+        self.pool = KVCache.create(cfg, self.num_slots, self.max_len, per_lane_index=True)
+        self.scratch = KVCache.create(cfg, 1, self.max_prompt_len)
+        self._decode = make_decode_window(model, self.window)
+        self._prefill = {b: make_prefill_chunk(model, b) for b in self.buckets}
+        self._insert = make_insert()
+
+        self.scheduler = Scheduler(
+            self.buckets,
+            prefill_token_budget if prefill_token_budget is not None else self.buckets[-1],
+        )
+
+        n = self.num_slots
+        # host-side per-slot lane state, shipped to the decode window each step
+        self._slot_req: List[Optional[Request]] = [None] * n
+        self._slot_ever_used = np.zeros(n, bool)
+        self._pending_tok = np.zeros(n, np.int32)
+        self._active = np.zeros(n, bool)
+        self._eos = np.full(n, -1, np.int32)
+        self._do_sample = np.zeros(n, bool)
+        self._temperature = np.ones(n, np.float32)
+        self._top_k = np.zeros(n, np.int32)
+        self._top_p = np.ones(n, np.float32)
+        self._rngs = np.zeros((n, 2), np.uint32)
+        self._base_rng = jax.random.PRNGKey(rng_seed)
+        self._reserved_slot: Optional[int] = None
+
+        self._next_rid = 0
+        self._step_count = 0
+        self.stats = {
+            "requests_submitted": 0,
+            "requests_completed": 0,
+            "tokens_generated": 0,
+            "prefill_chunks": 0,
+            "prefill_tokens": 0,
+            "decode_steps": 0,
+            "occupied_lane_steps": 0,
+            "slots_reused": 0,
+        }
+
+    # ------------------------------------------------------------- submission
+    def submit(
+        self,
+        prompt,
+        config: Optional[GenerationConfig] = None,
+        on_token: Optional[Callable[[Request, int], None]] = None,
+        **overrides: Any,
+    ) -> Request:
+        """Queue one request; returns its :class:`Request` handle (filled in
+        as the engine runs).  ``overrides`` patch the ``GenerationConfig``
+        exactly like :func:`~accelerate_tpu.models.generation.generate`."""
+        gen = config or GenerationConfig()
+        if overrides:
+            gen = dataclasses.replace(gen, **overrides)
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.size} > max_prompt_len {self.max_prompt_len}"
+            )
+        need = prompt.size + gen.max_new_tokens + self.window
+        if need > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {gen.max_new_tokens} + "
+                f"decode_window {self.window} = {need} exceeds slot capacity "
+                f"{self.max_len}"
+            )
+        req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
+                      submit_step=self._step_count)
+        self._next_rid += 1
+        self.scheduler.submit(req)
+        self.stats["requests_submitted"] += 1
+        return req
+
+    # -------------------------------------------------------------- admission
+    def _next_free_slot(self) -> Optional[int]:
+        for s in self.slot_order:
+            if not self._active[s] and self._slot_req[s] is None and s != self._reserved_slot:
+                return s
+        return None
+
+    def _admit(self) -> None:
+        budget = self.scheduler.begin_step()
+        while True:
+            if self.scheduler.prefilling is None:
+                slot = self._next_free_slot()
+                if slot is None or not self.scheduler.queue:
+                    return
+                self.scheduler.start_next(slot)
+                self._reserved_slot = slot
+                # scratch restarts at position 0; stale KV beyond each new
+                # write is unreachable (causal mask == valid-entry mask)
+                self.scratch = self.scratch.replace(index=jnp.zeros((), jnp.int32))
+            took = self.scheduler.take_chunk(budget)
+            if took is None:
+                return
+            req, bucket, valid, start = took
+            chunk = np.zeros(bucket, np.int32)
+            chunk[:valid] = req.prompt[start:start + valid]
+            self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
+            budget -= bucket
+            self.stats["prefill_chunks"] += 1
+            self.stats["prefill_tokens"] += valid
+            done = self.scheduler.finish_prefill()
+            if done is not None:
+                self._install(done)
+
+    def _install(self, req: Request) -> None:
+        """Insert a fully prefilled request into its reserved slot: one
+        ``dynamic_update_slice`` into the pool + host lane-state updates."""
+        s = req.slot
+        plen = len(req.prompt)
+        self.pool = self._insert(
+            self.pool, self.scratch.k, self.scratch.v,
+            jnp.int32(s), jnp.int32(plen - 1),
+        )
+        gen = req.config
+        self._pending_tok[s] = req.prompt[-1]
+        self._active[s] = True
+        self._eos[s] = -1 if gen.eos_token_id is None else gen.eos_token_id
+        self._do_sample[s] = gen.do_sample
+        self._temperature[s] = gen.temperature
+        self._top_k[s] = 0 if gen.top_k is None else gen.top_k
+        self._top_p[s] = 1.0 if gen.top_p is None else gen.top_p
+        self._rngs[s] = np.asarray(jax.random.fold_in(self._base_rng, req.rid))
+        if self._slot_ever_used[s]:
+            self.stats["slots_reused"] += 1
+        self._slot_ever_used[s] = True
+        self._slot_req[s] = req
+        self._reserved_slot = None
+        req.state = RequestState.RUNNING
+
+    # ----------------------------------------------------------------- decode
+    def _free(self, slot: int, req: Request) -> None:
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        req.state = RequestState.DONE
+        req.finish_step = self._step_count
+        self.stats["requests_completed"] += 1
+
+    def _decode_window(self) -> None:
+        if not self._active.any():
+            return
+        n_occupied = int(self._active.sum())
+        self.pool, toks, rngs = self._decode(
+            self.params, self.pool,
+            jnp.asarray(self._pending_tok), jnp.asarray(self._active),
+            jnp.asarray(self._eos), jnp.asarray(self._do_sample),
+            jnp.asarray(self._temperature), jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+            jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
+            jnp.asarray(self._rngs),
+        )
+        toks = np.asarray(jax.device_get(toks))
+        # copy: device_get hands back read-only buffers, but _install writes
+        # per-slot keys into this array on admission
+        self._rngs = np.array(jax.device_get(rngs), np.uint32)
+        self.stats["decode_steps"] += self.window
+        self.stats["occupied_lane_steps"] += n_occupied * self.window
+        for k in range(self.window):
+            for s in range(self.num_slots):
+                req = self._slot_req[s]
+                if req is None or not self._active[s]:
+                    continue
+                tok = int(toks[s, k])
+                finishing = req.finished(tok)
+                req.emit(tok)
+                self.stats["tokens_generated"] += 1
+                if finishing:
+                    self._free(s, req)
+                else:
+                    self._pending_tok[s] = tok
+
+    # ------------------------------------------------------------------ drive
+    def step(self) -> None:
+        """One engine iteration: budgeted chunked-prefill admission, then one
+        masked decode window over the pool."""
+        self._admit()
+        self._decode_window()
+        self._step_count += 1
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_queued or bool(self._active.any())
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive :meth:`step` until every submitted request completes."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(f"engine did not drain in {max_steps} steps")
+
+    def serve(
+        self,
+        prompts: Sequence,
+        configs=None,
+        on_token: Optional[Callable[[Request, int], None]] = None,
+    ) -> List[Request]:
+        """Convenience: submit every prompt (``configs`` is one shared or a
+        per-request list of ``GenerationConfig``), run to completion, return
+        the requests in submission order."""
+        reqs = []
+        for i, p in enumerate(prompts):
+            cfg = configs[i] if isinstance(configs, (list, tuple)) else configs
+            reqs.append(self.submit(p, config=cfg, on_token=on_token))
+        self.run()
+        return reqs
+
+    # ------------------------------------------------------------------ stats
+    def mean_slot_occupancy(self) -> float:
+        """Occupied lane-steps / total lane-steps across decode windows."""
+        total = self.stats["decode_steps"] * self.num_slots
+        return self.stats["occupied_lane_steps"] / total if total else 0.0
+
+    def compiled_executable_counts(self) -> dict:
+        """Per-executable jit-cache sizes — the no-retrace contract: after any
+        workload each entry is at most 1."""
+        out = {"decode_window": jit_cache_sizes(self._decode),
+               "insert": jit_cache_sizes(self._insert)}
+        for b, f in self._prefill.items():
+            out[f"prefill_{b}"] = jit_cache_sizes(f)
+        return out

@@ -1,0 +1,161 @@
+"""Slot-based KV pool: the fixed-shape compiled executables behind the engine.
+
+Iteration-level scheduling (Orca) and block-structured KV management (vLLM)
+win their 2-10x serving throughput by decoupling request lifetimes from the
+batch program: a request that finishes frees its KV capacity *immediately* and
+a queued request takes its place without restarting anyone else.  The TPU-first
+translation keeps everything inside a handful of fixed-shape executables — no
+per-request retracing:
+
+* **pool** — one :class:`~accelerate_tpu.models.transformer.KVCache` of
+  ``num_slots`` lanes with a *per-lane* ``index`` vector (each slot sits at its
+  own sequence position).  The model's cache path writes each lane at its own
+  index and masks attention per lane, so a single batched forward serves
+  whatever mix of requests currently occupies the pool.
+* **decode window** (:func:`make_decode_window`) — ONE jitted executable:
+  ``lax.scan`` over ``window`` masked decode steps.  Per-request sampling
+  knobs (eos / temperature / top-k / top-p) enter as traced *vectors*, so a
+  new request never forces a retrace.  Inactive or EOS-done lanes are frozen:
+  their index stops advancing and their emissions are masked to the pad token.
+  Greedy lanes take the same argmax ``generate`` takes — token-exact.
+* **prefill chunks** (:func:`make_prefill_chunk`) — one executable per chunk
+  *bucket* (e.g. 128/512).  A prompt prefills into a batch-1 scratch cache in
+  fixed-size chunks; only the final chunk is padded, and padded positions are
+  never attended (the causal mask is the valid-entry mask).
+* **insert** (:func:`make_insert`) — one executable: ``dynamic_update_slice``
+  of the scratch KV into a freed slot + setting that lane's length, without
+  disturbing running lanes.
+
+Compiled-shape budget for an engine instance: ``1 (decode window) +
+len(prefill_buckets) + 1 (insert)`` — asserted by the serving tests via the
+jit cache counters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import sample_tokens_batched
+from ..models.transformer import KVCache, Transformer
+
+
+def make_decode_window(model: Transformer, window: int):
+    """One jitted ``window``-step masked decode over the whole slot pool.
+
+    ``(params, cache, tokens [N], active [N], eos [N], do_sample [N],
+    temperature [N], top_k [N], top_p [N], pad [N], rngs [N,2])
+    -> (cache, out_tokens [N, window], new_rngs)``
+
+    Semantics per scan step (matching ``generate``'s loop body lane-by-lane):
+    the pending token is fed at each lane's own position, its KV is written
+    there, the next token is sampled per-lane, and lanes that are inactive or
+    have emitted their EOS freeze — index stops advancing and outputs are
+    masked to ``pad``.  Frozen lanes still execute (static shapes) but only
+    ever overwrite their own dead slot, so running lanes are untouched.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode_window(params, cache, tokens, active, eos, do_sample, temperature,
+                      top_k, top_p, pad, rngs):
+        def step(carry, _):
+            cache, tok, done, rngs = carry
+            prev_index = cache.index
+            logits, cache = model.apply({"params": params}, tok[:, None], cache=cache)
+            # model.apply advanced every lane; frozen lanes roll back
+            cache = cache.replace(
+                index=jnp.where(done, prev_index, prev_index + 1)
+            )
+            split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+            nxt = sample_tokens_batched(
+                logits[:, -1], split[:, 0],
+                do_sample=do_sample, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+            )
+            nxt = jnp.where(done, pad, nxt)
+            done = done | ((eos >= 0) & (nxt == eos))
+            return (cache, nxt, done, split[:, 1]), nxt
+
+        done0 = ~active
+        (cache, _, _, rngs), toks = jax.lax.scan(
+            step, (cache, tokens, done0, rngs), None, length=window
+        )
+        return cache, toks.T, rngs
+
+    return decode_window
+
+
+def make_prefill_chunk(model: Transformer, chunk_len: int):
+    """Jitted ``(params, tokens [1, chunk_len], scratch) -> scratch`` prefill.
+
+    Writes the chunk's KV into the batch-1 scratch cache at
+    ``scratch.index .. scratch.index + chunk_len`` and advances the index.
+    The final chunk of a prompt may be padded past the prompt's end: padded
+    positions write garbage KV *beyond* the valid length, which the causal
+    mask never lets any later query read (and :func:`make_insert` copies but
+    decode progressively overwrites).  Logits are discarded — the first
+    generated token comes from the shared decode step re-processing the last
+    prompt token, so prefill and decode share one sampling path.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def prefill_chunk(params, tokens, scratch):
+        _, scratch = model.apply({"params": params}, tokens, cache=scratch)
+        return scratch
+
+    return prefill_chunk
+
+
+def make_insert():
+    """Jitted ``insert_request``: copy a prefilled scratch KV into a freed slot.
+
+    ``(pool, scratch_k [L,1,Mp,H,D], scratch_v, slot, length) -> pool`` —
+    ``dynamic_update_slice`` at ``(0, slot, 0, 0, 0)`` writes one lane only;
+    running lanes' KV and indices are untouched (the property the slot-reuse
+    and permutation tests pin down).  ``length`` is ``prompt_len - 1``: the
+    last prompt token is left pending so the decode window computes the first
+    generated token through the same executable as every later token.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def insert_request(pool: KVCache, scratch_k, scratch_v, slot, length):
+        k = jax.lax.dynamic_update_slice(
+            pool.k, scratch_k.astype(pool.k.dtype), (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            pool.v, scratch_v.astype(pool.v.dtype), (0, slot, 0, 0, 0)
+        )
+        return pool.replace(k=k, v=v, index=pool.index.at[slot].set(length))
+
+    return insert_request
+
+
+def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int], ...]:
+    """Split a prompt into prefill chunks drawn from the fixed bucket sizes.
+
+    Returns ``((bucket_len, valid_len), ...)``: greedy largest-fit, so only
+    the final chunk can be padded (``valid_len < bucket_len``).  KV for the
+    prompt's last token is still *written* by prefill but re-written by the
+    first decode step — see :func:`make_insert`.
+    """
+    buckets = sorted(set(int(b) for b in buckets))
+    if not buckets or buckets[0] <= 0:
+        raise ValueError(f"prefill buckets must be positive, got {buckets}")
+    chunks = []
+    remaining = prompt_len
+    while remaining > 0:
+        fit = [b for b in buckets if b <= remaining]
+        b = max(fit) if fit else buckets[0]
+        chunks.append((b, min(b, remaining)))
+        remaining -= min(b, remaining)
+    return tuple(chunks)
+
+
+def jit_cache_sizes(*fns) -> int:
+    """Total number of compiled executables across jitted fns — the
+    no-per-request-retrace assertion counter (`f._cache_size()` is the
+    pjit-internal miss counter; 0 until first call)."""
+    return sum(int(f._cache_size()) for f in fns)

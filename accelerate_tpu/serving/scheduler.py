@@ -1,0 +1,139 @@
+"""Host-side request scheduling for the continuous-batching engine.
+
+The device side (:mod:`.pool`) is a fixed set of compiled executables; the
+scheduler is everything dynamic: a FCFS request queue, per-request
+:class:`~accelerate_tpu.models.generation.GenerationConfig`, chunked-prefill
+progress, and an admission policy bounded by a **prefill-token budget per
+engine step** — the Orca/Sarathi knob that keeps decode-step latency jitter
+bounded while new prompts stream in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.generation import GenerationConfig
+from .pool import plan_chunks
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    DONE = "done"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request: prompt + per-request generation config + progress.
+
+    ``on_token(request, token)`` streams each generated token as the engine
+    observes it (window granularity); ``tokens`` accumulates the final
+    generated ids (EOS included when hit, never the post-EOS padding).
+    """
+
+    rid: int
+    prompt: np.ndarray                      # [S] int32
+    config: GenerationConfig
+    on_token: Optional[Callable[["Request", int], None]] = None
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    # chunked-prefill progress
+    chunks: Tuple[Tuple[int, int], ...] = ()
+    next_chunk: int = 0
+    submit_step: int = -1
+    finish_step: int = -1
+
+    @property
+    def done(self) -> bool:
+        return self.state is RequestState.DONE
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """Prompt + generated tokens (the ``generate`` row, pad tail trimmed)."""
+        return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def emit(self, token: int) -> None:
+        self.tokens.append(int(token))
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+    def finished(self, token: int) -> bool:
+        """Would emitting ``token`` complete this request?"""
+        eos = self.config.eos_token_id
+        return (eos is not None and int(token) == eos) or (
+            len(self.tokens) + 1 >= self.config.max_new_tokens
+        )
+
+
+class Scheduler:
+    """FCFS admission with a per-step prefill-token budget.
+
+    One request prefills at a time (the scratch cache is batch-1); its chunks
+    are charged against ``prefill_token_budget`` each engine step, so a long
+    prompt spreads across steps instead of stalling every running request for
+    its whole prefill (chunked prefill, Sarathi-style).
+    """
+
+    def __init__(self, prefill_buckets: Sequence[int], prefill_token_budget: int):
+        self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
+        if not self.buckets:
+            raise ValueError("need at least one prefill bucket")
+        self.budget = int(prefill_token_budget)
+        if self.budget < self.buckets[0]:
+            raise ValueError(
+                f"prefill_token_budget {self.budget} cannot fit the smallest "
+                f"bucket {self.buckets[0]} — no prompt would ever be admitted"
+            )
+        self.queue: deque = deque()
+        self.prefilling: Optional[Request] = None
+
+    def submit(self, request: Request) -> None:
+        request.chunks = plan_chunks(len(request.prompt), self.buckets)
+        self.queue.append(request)
+
+    @property
+    def has_queued(self) -> bool:
+        return bool(self.queue) or self.prefilling is not None
+
+    def begin_step(self) -> int:
+        """Fresh prefill-token budget for this engine step."""
+        return self.budget
+
+    def start_next(self, slot: int) -> Optional[Request]:
+        """Pop the FCFS head into PREFILL state, bound for ``slot``."""
+        if self.prefilling is not None or not self.queue:
+            return None
+        req = self.queue.popleft()
+        req.state = RequestState.PREFILL
+        req.slot = slot
+        self.prefilling = req
+        return req
+
+    def take_chunk(self, budget: int) -> Optional[Tuple[Request, int, int, int]]:
+        """Next prefill chunk fitting ``budget``:
+        ``(request, bucket_len, valid_len, start)`` or None."""
+        req = self.prefilling
+        if req is None or req.next_chunk >= len(req.chunks):
+            return None
+        bucket, valid = req.chunks[req.next_chunk]
+        if bucket > budget:
+            return None
+        start = sum(v for _, v in req.chunks[: req.next_chunk])
+        req.next_chunk += 1
+        return req, bucket, valid, start
+
+    def finish_prefill(self) -> Optional[Request]:
+        """If the in-flight request has prefilled every chunk, hand it over
+        for insertion and clear the prefill lane."""
+        req = self.prefilling
+        if req is not None and req.next_chunk >= len(req.chunks):
+            self.prefilling = None
+            return req
+        return None

@@ -369,7 +369,7 @@ def make_chunk_apply(
     orig_pos = {j: i for i, j in enumerate(orig_ids)}
 
     def fn(chunk_leaves, chunk_grads, chunk_opt_state):
-        from jax.memory import Space
+        from .jax_compat import Space
 
         if opt_on_host:
             chunk_opt_state = jax.device_put(chunk_opt_state, Space.Device)

@@ -1,0 +1,37 @@
+"""Version portability for jax APIs that moved between the 0.4.x and 0.6+
+lines.  The host-offload paths were written against ``jax.memory.Space``
+(0.6+); on 0.4.x the same in-jit placement is spelled
+``TransferToMemoryKind("<kind>")``.  Import :data:`Space` from here instead of
+``jax.memory`` — both spellings are accepted by ``jax.device_put`` *inside*
+``jax.jit``, which is the only place the offload code calls it.
+
+(The matching ``shard_map`` shim lives in ``parallel/mesh.py`` next to its
+call sites.)
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax.memory import Space  # type: ignore[import-not-found]
+except ImportError:  # jax 0.4.x
+    import jax as _jax
+    from jax._src.sharding_impls import TransferToMemoryKind as _Transfer
+
+    def _has_host_memory() -> bool:
+        # single-memory backends (the forced-CPU test rig) can't compile
+        # annotate_device_placement custom calls; degrade transfers to no-ops
+        # (device_put(x, None)) so offload paths run un-offloaded instead of
+        # hitting an XLA RET_CHECK
+        try:
+            return len(_jax.devices()[0].addressable_memories()) > 1
+        except Exception:
+            return False
+
+    class Space:  # type: ignore[no-redef]
+        """0.4.x stand-in: attributes are in-jit ``device_put`` destinations."""
+
+        Device = _Transfer("device") if _has_host_memory() else None
+        Host = _Transfer("pinned_host") if _has_host_memory() else None
+
+
+__all__ = ["Space"]

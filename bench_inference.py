@@ -14,7 +14,14 @@ HBM while the MXU computes.  Tasks:
   generation with a KV cache, every token streaming the full weight set
   host→HBM.  Reports decode tokens/s and s/token
   (``benchmarks/big_model_inference.py:141-155`` measures exactly this);
-* ``--task prefill`` — batch x seq tokens per forward / wall time.
+* ``--task prefill`` — batch x seq tokens per forward / wall time;
+* ``--task serve`` — the continuous-batching engine
+  (:mod:`accelerate_tpu.serving`) on a log-normal mixed-length workload vs
+  static ``generate`` over the same requests in FCFS groups padded to the
+  workload max — the padding + lockstep waste the slot pool exists to
+  reclaim.  HBM-resident weights (serving is not an offload bench); reports
+  tokens/s, per-token latency percentiles, slot occupancy, and ``vs_baseline``
+  = engine tokens/s over static tokens/s.
 
 Either way ``effective stream GB/s`` — model bytes transferred per step / wall
 time — is the engine-quality number; ``vs_baseline`` compares it to the
@@ -67,10 +74,132 @@ def _presets():
     }
 
 
+def _serve_bench(args, model, cfg, params, preset):
+    """Continuous batching vs static ``generate`` on one mixed-length workload.
+
+    Both sides decode greedily and both get credited only the USEFUL tokens
+    (each request's own output length).  The static baseline runs the
+    requests FCFS in groups of ``--batch``, every group padded to the
+    workload's max prompt / max output — ONE compiled shape, warmed up before
+    timing, exactly how ``generate`` would serve this queue.  The engine
+    serves the same queue through the slot pool with chunked prefill and
+    in-flight admission.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig, generate
+    from accelerate_tpu.serving import ServingEngine
+
+    params = jax.device_put(params)  # HBM-resident: serving is not an offload bench
+    slots = args.batch
+    window = args.decode_window
+    max_len = cfg.max_seq_len
+    mp = max(8, min(args.seq, max_len) // 2)          # longest admissible prompt
+    buckets = tuple(sorted({max(8, mp // 4), max(8, mp // 2)}))
+
+    # log-normal mixed lengths — the serving-paper workload shape (most
+    # requests short, a heavy tail; ShareGPT-like sigma ~1), clipped to the
+    # slot capacity
+    r = np.random.default_rng(args.serve_seed)
+    out_cap = min(max_len - window - mp, 2 * mp)
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, args.requests)), 4, mp
+    ).astype(int)
+    out_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, out_cap // 8)), 1.0, args.requests)), 4, out_cap
+    ).astype(int)
+    prompts = [r.integers(1, cfg.vocab_size, (int(n),)).astype(np.int32) for n in prompt_lens]
+    gens = [GenerationConfig(max_new_tokens=int(n)) for n in out_lens]
+    useful_tokens = int(out_lens.sum())
+
+    # slot capacity sized to the workload (like the static baseline's cache:
+    # prompt + new tokens), not the model's full context — attention cost per
+    # decode step scales with slot width
+    slot_len = min(
+        max_len,
+        int(max(p + o for p, o in zip(prompt_lens, out_lens))) + window,
+    )
+    eng = ServingEngine(
+        model, params, num_slots=slots, max_len=slot_len,
+        prefill_buckets=buckets, max_prompt_len=mp, decode_window=window,
+    )
+    # warmup: one request per bucket length compiles every executable (each
+    # prefill bucket, insert, the decode window) on this engine instance
+    eng.serve([r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets],
+              GenerationConfig(max_new_tokens=window))
+    for k in eng.stats:
+        eng.stats[k] = 0
+
+    stamps = {}
+
+    def on_token(req, tok):
+        stamps.setdefault(req.rid, []).append(time.perf_counter())
+
+    t0 = time.perf_counter()
+    reqs = eng.serve(prompts, gens, on_token=on_token)
+    dt_engine = time.perf_counter() - t0
+    engine_tps = useful_tokens / dt_engine
+    # per-token latency samples at decode-window granularity, queue wait
+    # included (what a caller actually observes)
+    samples = np.concatenate(
+        [np.diff(np.asarray([t0] + stamps[req.rid])) for req in reqs]
+    )
+
+    # static baseline: FCFS groups of `slots`, padded to the workload max —
+    # one compiled (prompt, new_tokens) shape for every group
+    P, N = int(prompt_lens.max()), int(out_lens.max())
+    static_gen = GenerationConfig(max_new_tokens=N)
+    batch = np.zeros((slots, P), np.int32)
+
+    def run_group(idx):
+        batch[:] = 0
+        for row, i in enumerate(idx):
+            batch[row, : len(prompts[i])] = prompts[i]
+        seqs, _ = generate(model, params, jnp.asarray(batch), static_gen)
+        return jax.block_until_ready(seqs)
+
+    run_group(range(min(slots, len(prompts))))  # warmup / compile
+    t0 = time.perf_counter()
+    for start in range(0, len(prompts), slots):
+        run_group(range(start, min(start + slots, len(prompts))))
+    dt_static = time.perf_counter() - t0
+    static_tps = useful_tokens / dt_static
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": args.requests,
+        "num_slots": slots,
+        "decode_window": window,
+        "prefill_buckets": list(buckets),
+        "prompt_len_p50_max": [int(np.median(prompt_lens)), int(prompt_lens.max())],
+        "out_len_p50_max": [int(np.median(out_lens)), int(out_lens.max())],
+        "useful_tokens": useful_tokens,
+        "engine_wall_s": round(dt_engine, 3),
+        "static_wall_s": round(dt_static, 3),
+        "static_tokens_per_s": round(static_tps, 2),
+        "token_latency_p50_ms": round(1e3 * float(np.percentile(samples, 50)), 2),
+        "token_latency_p99_ms": round(1e3 * float(np.percentile(samples, 99)), 2),
+        "mean_slot_occupancy": round(eng.mean_slot_occupancy(), 3),
+        "compiled_executables": eng.compiled_executable_counts(),
+    }
+    return {
+        "metric": "serving_tokens_per_sec",
+        "value": round(engine_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(engine_tps / static_tps, 3),
+        "detail": detail,
+    }
+
+
 def main():
     presets = _presets()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--task", choices=["decode", "prefill"], default="decode")
+    parser.add_argument("--task", choices=["decode", "prefill", "serve"], default="decode")
+    parser.add_argument("--requests", type=int, default=16,
+                        help="serve task: total queued requests (depth > --batch slots)")
+    parser.add_argument("--decode_window", type=int, default=8,
+                        help="serve task: decode steps fused per engine iteration")
+    parser.add_argument("--serve_seed", type=int, default=0,
+                        help="serve task: workload RNG seed")
     parser.add_argument("--preset", choices=list(presets), default=None,
                         help="default: small on TPU, tiny elsewhere (gpt2-xl = parity geometry)")
     parser.add_argument("--batch", type=int, default=8)
@@ -151,6 +280,14 @@ def main():
             r = np.random.default_rng(i)
             host_leaves.append((r.standard_normal(leaf.shape, dtype=np.float32) * 0.02).astype(jnp.bfloat16))
         params = jax.tree_util.tree_unflatten(treedef, host_leaves)
+
+    if args.task == "serve":
+        if args.bits is not None:
+            raise SystemExit("--task serve benches HBM-resident decode; --bits "
+                             "applies to the streaming tasks")
+        result = _serve_bench(args, model, cfg, params, preset)
+        print(json.dumps(result))
+        return
 
     stream_cfg = cfg
     if args.bits is not None:

@@ -35,6 +35,7 @@ SLOW_MODULES = {
     "test_multiprocess",
     "test_generation",
     "test_pipeline",
+    "test_serving",
     "test_flash_attention",
     "test_ring_attention",
     "test_fp8",

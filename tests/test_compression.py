@@ -14,6 +14,7 @@ from accelerate_tpu.parallel.compression import (
     is_compressible,
     powersgd_init,
 )
+from accelerate_tpu.parallel.mesh import shard_map
 from accelerate_tpu.utils.dataclasses import CollectiveKwargs
 
 
@@ -47,7 +48,7 @@ def _pmean_harness(grads, state, dp=4):
         return ghat, ns
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh,
             in_specs=(g_specs, s_specs),
             out_specs=(jax.tree_util.tree_map(lambda _: P(), grads), s_specs),

@@ -1090,6 +1090,42 @@ class TestDispatchIntegration:
             config_from_hf(str(tmp_path))
 
 
+class TestStreamMappedTensors:
+    def test_fan_out_one_hf_tensor_to_many_natives(self, tmp_path):
+        """Several native keys citing the SAME HF tensor (tied embeddings,
+        fused-qkv splits) must all materialize — the inverted dict used to
+        keep only the last native and misreport the rest as missing."""
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu.models.hf_compat import stream_mapped_tensors
+
+        fused = np.arange(12, dtype=np.float32).reshape(3, 4)
+        solo = np.ones((2,), np.float32)
+        save_file({"fused": fused, "solo": solo},
+                  os.path.join(tmp_path, "model.safetensors"))
+        mapping = {
+            "a": ("fused", lambda t: t[:, :2]),
+            "b": ("fused", lambda t: t[:, 2:].T),
+            "c": ("solo", lambda t: t * 3.0),
+        }
+        flat = stream_mapped_tensors(str(tmp_path), mapping)
+        assert set(flat) == {"a", "b", "c"}
+        np.testing.assert_array_equal(flat["a"], fused[:, :2])
+        np.testing.assert_array_equal(flat["b"], fused[:, 2:].T)
+        np.testing.assert_array_equal(flat["c"], solo * 3.0)
+
+    def test_missing_mapped_tensor_still_raises(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        from accelerate_tpu.models.hf_compat import stream_mapped_tensors
+
+        save_file({"present": np.zeros((2,), np.float32)},
+                  os.path.join(tmp_path, "model.safetensors"))
+        mapping = {"x": ("present", lambda t: t), "y": ("absent", lambda t: t)}
+        with pytest.raises(ValueError, match="missing tensors"):
+            stream_mapped_tensors(str(tmp_path), mapping)
+
+
 class TestScanLayout:
     def test_restacked_params_match(self, tmp_path):
         """Converted layers_{i} layout restacks into scan_layers=True and

@@ -118,6 +118,30 @@ class TestPreparePipeline:
         out = fn(params, ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.parametrize("recipe", ["gpt2", "bloom", "opt"])
+    def test_embed_stage_variants_match_monolithic(self, recipe):
+        """The replicated embed stage must run the FULL embed recipe — scale,
+        embed_norm (BLOOM), learned position table with offset (GPT-2/OPT) —
+        in monolithic order; these families previously diverged under pp."""
+        variants = {
+            "gpt2": dict(norm_type="layernorm", use_bias=True, positional="learned",
+                         mlp_variant="gelu", tie_word_embeddings=True),
+            "bloom": dict(norm_type="layernorm", use_bias=True, positional="alibi",
+                          mlp_variant="gelu", embed_norm=True, tie_word_embeddings=True),
+            "opt": dict(norm_type="layernorm", use_bias=True, positional="learned",
+                        pos_offset=2, mlp_variant="relu", tie_word_embeddings=True),
+        }
+        cfg = TransformerConfig.tiny(
+            num_layers=4, dtype=jnp.float32, param_dtype=jnp.float32, **variants[recipe]
+        )
+        model = Transformer(cfg)
+        ids = jnp.asarray(np.random.default_rng(2).integers(0, cfg.vocab_size, (8, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        ref = model.apply({"params": params}, ids)
+        fn = prepare_pipeline(model, params, mesh=make_mesh(pp=4), num_microbatches=4)
+        out = fn(params, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
     def test_ragged_batch_pads_and_matches_monolithic(self):
         """batch % num_microbatches != 0: the pipeline pads internally and
         slices the logits back — outputs match the monolithic forward on the

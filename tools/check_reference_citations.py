@@ -60,26 +60,25 @@ def _resolve(cache: dict, relpath: str, include_repo: bool = False) -> int | Non
     """Line count of a shorthand-cited reference file.  Docstrings cite
     relative to ``src/accelerate/`` ("utils/dataclasses.py"), the repo root
     ("tests/test_multigpu.py", "benchmarks/..."), or by bare filename when the
-    module mirrors its reference counterpart ("operations.py") — accept any
-    unambiguous resolution, largest line count when basenames collide.
+    module mirrors its reference counterpart ("operations.py").  Resolution is
+    exact-path first, in base-priority order — taking the max across colliding
+    candidates would let any long same-named file mask a stale citation.  The
+    basename fallback applies only when exactly ONE file of that name exists;
+    an ambiguous basename resolves to nothing (cite a qualified path instead).
     ``include_repo`` additionally resolves against this repo's own tree (the
     GENERIC self-citation form, e.g. ``models/transformer.py:208``)."""
     bases = [REF_SRC, REF_ROOT, os.path.join(REF_ROOT, "src")]
     if include_repo:
         bases += [PKG, REPO, os.path.join(REPO, "accelerate_tpu")]
-    best = None
     for base in bases:
         total = _file_lines(cache, os.path.join(base, relpath))
         if total is not None:
-            best = max(best or 0, total)
-    if best is not None:
-        return best
+            return total
     candidates = list(_basename_index().get(os.path.basename(relpath), []))
     if include_repo:
         candidates += _repo_basename_index().get(os.path.basename(relpath), [])
-    totals = [_file_lines(cache, c) for c in candidates]
-    totals = [t for t in totals if t is not None]
-    return max(totals) if totals else None
+    totals = [t for t in (_file_lines(cache, c) for c in candidates) if t is not None]
+    return totals[0] if len(totals) == 1 else None
 
 
 _REPO_BASENAMES: dict = {}
