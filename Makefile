@@ -46,3 +46,4 @@ bench:
 quality:
 	python -m compileall -q accelerate_tpu
 	python tools/check_reference_citations.py
+	python tools/check_no_bare_print.py

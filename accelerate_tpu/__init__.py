@@ -68,6 +68,16 @@ from .ops import (
     quantize_model_params,
 )
 from .serving import ServingEngine
+from . import telemetry
+from .telemetry import (
+    MetricsRegistry,
+    RecompileWatchdog,
+    Tracer,
+    get_registry,
+    get_tracer,
+    span,
+    watch_recompiles,
+)
 from .local_sgd import LocalSGD
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler

@@ -42,6 +42,7 @@ import gc
 import inspect
 import math
 import os
+import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -58,6 +59,11 @@ from .parallel import mesh as mesh_lib
 from .parallel.sharding import make_opt_sharding_fn, make_param_sharding_fn
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry import get_registry as _get_telemetry_registry
+from .telemetry import get_tracer as _get_tracer
+from .telemetry import metrics as _telemetry_metrics
+from .telemetry.tracer import set_device_trace_active
+from .telemetry.watchdog import RecompileWatchdog
 from .train_state import DynamicLossScale, TrainState, global_norm, tree_finite
 from .utils import operations as ops
 from .utils.dataclasses import (
@@ -104,6 +110,23 @@ def _is_dataloader_like(obj) -> bool:
 
 def _is_optimizer_like(obj) -> bool:
     return isinstance(obj, (optax.GradientTransformation, AcceleratedOptimizer))
+
+
+def _batch_token_count(batch) -> int:
+    """Token count of a batch for throughput accounting: the largest 2-D
+    integer leaf ([B, S] token ids) wins; batches without one (e.g. CV
+    images) fall back to the largest leading dim, i.e. samples."""
+    tokens = 0
+    samples = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if not shape:
+            continue
+        samples = max(samples, int(shape[0]))
+        dtype = getattr(leaf, "dtype", None)
+        if len(shape) == 2 and dtype is not None and jnp.issubdtype(dtype, jnp.integer):
+            tokens = max(tokens, int(shape[0]) * int(shape[1]))
+    return tokens or samples
 
 
 def _is_model_like(obj) -> bool:
@@ -256,6 +279,11 @@ class Accelerator:
         # keyed by the identity of their optax transformation.
         self._latest_state: Optional[TrainState] = None
         self._latest_state_by_tx: Dict[int, TrainState] = {}
+
+        # Unified telemetry (telemetry/): the process registry + span tracer
+        # every built-in surface records into.  See docs/usage/observability.md.
+        self.telemetry = _get_telemetry_registry()
+        self.tracer = _get_tracer()
 
     def _track_state(self, state: TrainState) -> TrainState:
         self._latest_state = state
@@ -1117,11 +1145,19 @@ class Accelerator:
         max_grad_norm: Optional[float] = None,
         max_grad_value: Optional[float] = None,
         donate: bool = True,
+        compile_budget: Optional[int] = 4,
     ) -> Callable:
         """Compile the full training step: fwd+bwd+accumulate+clip+update.
 
         ``loss_fn(params, batch[, rng]) -> loss`` (or ``(loss, aux)`` with
         ``has_aux``).  Returns ``step(state, batch) -> (state, metrics)``.
+
+        The step is telemetry-instrumented (``train/step_time_s`` histogram,
+        ``train/tokens_per_s`` + deferred ``train/grad_norm`` gauges) and its
+        compiled program sits behind a :class:`RecompileWatchdog`: more than
+        ``compile_budget`` distinct ``(shape, dtype)`` call signatures — a
+        varying batch shape silently retracing — logs a visible warning.
+        ``compile_budget=None`` counts without warning.
 
         Gradient accumulation is compiled in: for ``num_steps`` N, the optimizer
         applies on every N-th call (and on the final batch of an epoch, mirroring
@@ -1475,6 +1511,16 @@ class Accelerator:
         else:
             jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
 
+        # Recompile watchdog over the compiled program: every distinct
+        # (shape, dtype) call signature is a (re)trace; past the budget the
+        # silent-retrace failure mode becomes a logged warning + gauge.
+        jitted = RecompileWatchdog(
+            jitted,
+            name=f"train_step/{getattr(loss_fn, '__name__', 'loss')}",
+            budget=compile_budget,
+            registry=self.telemetry,
+        )
+
         # python mirror of the chunked path's micro-step counter (see above)
         _micro_mirror: Dict[str, Any] = {"ref": None, "micro": 0}
 
@@ -1569,8 +1615,44 @@ class Accelerator:
             gs._set_sync_gradients(synced)
             return new_state, metrics
 
-        step._jitted = jitted
-        return step
+        # Telemetry wrapper: a disabled registry short-circuits to the raw
+        # step (one boolean check); enabled it costs two perf_counter reads,
+        # a histogram bisect, and gauge stores.  grad_norm/loss gauges hold
+        # the live device values — the D2H happens at snapshot time, never
+        # in-loop, so async dispatch is preserved.
+        registry = self.telemetry
+        tracer = self.tracer
+        step_hist = registry.histogram("train/step_time_s", help="train step wall time (s)")
+        steps_total = registry.counter("train/steps_total", help="train step calls")
+        tokens_total = registry.counter("train/tokens_total", help="tokens (or samples) stepped")
+        tps_gauge = registry.gauge("train/tokens_per_s", help="last-step token throughput")
+        gnorm_gauge = registry.gauge("train/grad_norm", help="last-step gradient norm (deferred)")
+        loss_gauge = registry.gauge("train/loss", help="last-step loss (deferred)")
+
+        @functools.wraps(step)
+        def instrumented(state, batch):
+            if not _telemetry_metrics.enabled():
+                return step(state, batch)
+            t0 = time.perf_counter()
+            with tracer.span("train/step"):
+                new_state, metrics = step(state, batch)
+            dt = time.perf_counter() - t0
+            step_hist.observe(dt)
+            steps_total.inc()
+            ntok = _batch_token_count(batch)
+            if ntok:
+                tokens_total.inc(ntok)
+                tps_gauge.set(ntok / dt if dt > 0 else 0.0)
+            if isinstance(metrics, dict):
+                if metrics.get("grad_norm") is not None:
+                    gnorm_gauge.set(metrics["grad_norm"])
+                if metrics.get("loss") is not None:
+                    loss_gauge.set(metrics["loss"])
+            return new_state, metrics
+
+        instrumented._jitted = jitted
+        instrumented._watchdog = jitted
+        return instrumented
 
     def _apply_chunked(
         self, state: TrainState, avg, info, opt_on_host: bool, params_on_host: bool,
@@ -1652,8 +1734,15 @@ class Accelerator:
             step=state.step + 1,
         )
 
-    def compile_eval_step(self, eval_fn: Callable, *, donate: bool = False) -> Callable:
-        """Compile an eval/predict step: ``eval_fn(params, batch[, rng])`` with policy cast."""
+    def compile_eval_step(
+        self, eval_fn: Callable, *, donate: bool = False,
+        compile_budget: Optional[int] = 4,
+    ) -> Callable:
+        """Compile an eval/predict step: ``eval_fn(params, batch[, rng])`` with policy cast.
+
+        Instrumented like the train step: ``eval/step_time_s`` histogram and a
+        recompile watchdog with the same ``compile_budget`` semantics.
+        """
         wrapped = self._wrap_loss_fn(eval_fn, has_aux=False)
         offload_params, _ = self._offload_flags()
 
@@ -1667,8 +1756,28 @@ class Accelerator:
             out, _ = wrapped(params, batch, None)
             return self.policy.cast_to_output(out)
 
-        jitted = jax.jit(_step, donate_argnums=())
-        return jitted
+        jitted = RecompileWatchdog(
+            jax.jit(_step, donate_argnums=()),
+            name=f"eval_step/{getattr(eval_fn, '__name__', 'eval')}",
+            budget=compile_budget,
+            registry=self.telemetry,
+        )
+        registry = self.telemetry
+        tracer = self.tracer
+        eval_hist = registry.histogram("eval/step_time_s", help="eval step wall time (s)")
+
+        @functools.wraps(eval_fn)
+        def instrumented(state_or_params, batch):
+            if not _telemetry_metrics.enabled():
+                return jitted(state_or_params, batch)
+            t0 = time.perf_counter()
+            with tracer.span("eval/step"):
+                out = jitted(state_or_params, batch)
+            eval_hist.observe(time.perf_counter() - t0)
+            return out
+
+        instrumented._jitted = jitted
+        return instrumented
 
     # ----------------------------------------------------- imperative mirror
     @contextlib.contextmanager
@@ -2004,10 +2113,16 @@ class Accelerator:
         """First-class profiler capture (exceeds reference; SURVEY §5.1).
 
         Wraps ``jax.profiler`` trace capture; view with TensorBoard or Perfetto.
+        While the capture is live, telemetry spans (``tracer.span`` /
+        ``telemetry.span``) also enter ``jax.profiler.TraceAnnotation`` so the
+        host-side phase names line up against the device timeline.
         """
         log_dir = log_dir or os.path.join(self.project_dir or ".", "profile")
         jax.profiler.start_trace(log_dir)
+        set_device_trace_active(True)
         try:
-            yield
+            with self.tracer.span("profile", log_dir=log_dir):
+                yield
         finally:
+            set_device_trace_active(False)
             jax.profiler.stop_trace()

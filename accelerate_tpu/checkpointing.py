@@ -33,6 +33,7 @@ import pickle
 import random
 import re
 import shutil
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -40,10 +41,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, SeedableRandomSampler
+from .telemetry import get_registry as _get_telemetry_registry
+from .telemetry import get_tracer as _get_tracer
 from .train_state import DynamicLossScale, TrainState
 
 MODEL_SAFE_NAME = "model.safetensors"
 SAFE_INDEX_NAME = "model.safetensors.index.json"
+
+
+def _tree_nbytes(tree: Any) -> int:
+    """Total array bytes in a pytree (host or device leaves)."""
+    return sum(
+        int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree_util.tree_leaves(tree)
+    )
 
 
 # ----------------------------------------------------------------- tree <-> io
@@ -95,7 +105,33 @@ def save_accelerator_state(
     safe_serialization: bool = True,
 ) -> str:
     """Save everything needed to resume (reference ``save_accelerator_state``,
-    ``checkpointing.py:51-149`` + automatic naming ``accelerator.py:2896-2921``)."""
+    ``checkpointing.py:51-149`` + automatic naming ``accelerator.py:2896-2921``).
+
+    Instrumented: a ``checkpoint/save`` span, the ``checkpoint/save_s``
+    histogram, and ``checkpoint/saved_bytes_total`` (train-state array bytes).
+    """
+    registry = _get_telemetry_registry()
+    t0 = time.perf_counter()
+    with _get_tracer().span("checkpoint/save"):
+        out = _save_accelerator_state_impl(
+            accelerator, output_dir, state, safe_serialization
+        )
+    registry.histogram(
+        "checkpoint/save_s", help="save_accelerator_state wall time"
+    ).observe(time.perf_counter() - t0)
+    if state is not None:
+        registry.counter(
+            "checkpoint/saved_bytes_total", help="train-state array bytes written"
+        ).inc(_tree_nbytes(_state_to_tree(state)))
+    return out
+
+
+def _save_accelerator_state_impl(
+    accelerator,
+    output_dir: Optional[str],
+    state: Optional[TrainState] = None,
+    safe_serialization: bool = True,
+) -> str:
     pc = accelerator.project_configuration
     if pc.automatic_checkpoint_naming:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
@@ -189,7 +225,31 @@ def load_accelerator_state(
     state: Optional[TrainState] = None,
     load_kwargs: Optional[dict] = None,
 ) -> Optional[TrainState]:
-    """Mirror of :func:`save_accelerator_state` (reference ``checkpointing.py:152-254``)."""
+    """Mirror of :func:`save_accelerator_state` (reference ``checkpointing.py:152-254``).
+
+    Instrumented: a ``checkpoint/restore`` span, the ``checkpoint/restore_s``
+    histogram, and ``checkpoint/restored_bytes_total``.
+    """
+    registry = _get_telemetry_registry()
+    t0 = time.perf_counter()
+    with _get_tracer().span("checkpoint/restore"):
+        out = _load_accelerator_state_impl(accelerator, input_dir, state, load_kwargs)
+    registry.histogram(
+        "checkpoint/restore_s", help="load_accelerator_state wall time"
+    ).observe(time.perf_counter() - t0)
+    if out is not None:
+        registry.counter(
+            "checkpoint/restored_bytes_total", help="train-state array bytes restored"
+        ).inc(_tree_nbytes(_state_to_tree(out)))
+    return out
+
+
+def _load_accelerator_state_impl(
+    accelerator,
+    input_dir: Optional[str],
+    state: Optional[TrainState] = None,
+    load_kwargs: Optional[dict] = None,
+) -> Optional[TrainState]:
     pc = accelerator.project_configuration
     if input_dir is None and pc.automatic_checkpoint_naming:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
@@ -309,7 +369,32 @@ def save_model(
     HF ecosystem (``model.safetensors`` or N shards + ``model.safetensors.index.json``).
     ``save_dtype`` casts floating weights on export (``ZeroPlugin.
     zero3_save_16bit_model`` passes bf16 — the fp32 masters stay untouched).
+
+    Instrumented: a ``checkpoint/save_model`` span, ``checkpoint/save_model_s``
+    histogram, and ``checkpoint/model_saved_bytes_total`` (shard bytes, main
+    process only).
     """
+    registry = _get_telemetry_registry()
+    t0 = time.perf_counter()
+    with _get_tracer().span("checkpoint/save_model"):
+        written = _save_model_impl(
+            accelerator, state_or_params, save_directory,
+            max_shard_size, safe_serialization, save_dtype,
+        )
+    registry.histogram(
+        "checkpoint/save_model_s", help="save_model wall time"
+    ).observe(time.perf_counter() - t0)
+    return written
+
+
+def _save_model_impl(
+    accelerator,
+    state_or_params,
+    save_directory: str,
+    max_shard_size="10GB",
+    safe_serialization: bool = True,
+    save_dtype=None,
+) -> List[str]:
     from safetensors.numpy import save_file
 
     from .utils.operations import _gather_one
@@ -342,6 +427,9 @@ def save_model(
         shards[-1][key] = flat[key]
         sizes[-1] += nbytes
 
+    _get_telemetry_registry().counter(
+        "checkpoint/model_saved_bytes_total", help="safetensors shard bytes written"
+    ).inc(sum(sizes))
     written: List[str] = []
     if len(shards) == 1:
         path = os.path.join(save_directory, MODEL_SAFE_NAME)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Union
 
 import jax
@@ -27,6 +28,7 @@ import numpy as np
 
 from .parallel import mesh as mesh_lib
 from .state import GradientState, PartialState
+from .telemetry import get_registry as _get_telemetry_registry
 from .utils.dataclasses import DataLoaderConfiguration, RNGType
 from .utils.operations import (
     broadcast,
@@ -446,6 +448,23 @@ class DataLoaderShard(DataLoaderStateMixin):
         if sampler is not None and hasattr(sampler, "set_epoch"):
             sampler.set_epoch(epoch)
 
+    def _fetch_and_place(self, raw_iter):
+        """``next(raw_iter)`` then device placement, timed separately into the
+        ``data/fetch_s`` / ``data/device_put_s`` histograms — a slow input
+        pipeline and a slow host-to-device path look identical from step time
+        alone.  ``StopIteration`` propagates to the prefetch loop."""
+        t0 = time.perf_counter()
+        batch = next(raw_iter)
+        t1 = time.perf_counter()
+        placed = self.placer.place(batch)
+        t2 = time.perf_counter()
+        registry = _get_telemetry_registry()
+        registry.histogram("data/fetch_s", help="host batch fetch wall time").observe(t1 - t0)
+        registry.histogram(
+            "data/device_put_s", help="device placement dispatch wall time"
+        ).observe(t2 - t1)
+        return placed
+
     def __iter__(self):
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
@@ -463,7 +482,7 @@ class DataLoaderShard(DataLoaderStateMixin):
             exhausted = False
             while not exhausted and len(window) < self.prefetch_size:
                 try:
-                    window.append(self.placer.place(next(raw_iter)))
+                    window.append(self._fetch_and_place(raw_iter))
                 except StopIteration:
                     exhausted = True
             while window:
@@ -472,7 +491,7 @@ class DataLoaderShard(DataLoaderStateMixin):
                 current = window.pop(0)
                 if not exhausted:
                     try:
-                        window.append(self.placer.place(next(raw_iter)))
+                        window.append(self._fetch_and_place(raw_iter))
                     except StopIteration:
                         exhausted = True
                 yield current

@@ -7,7 +7,6 @@ that gates records on ``main_process_only`` / per-process emission and supports
 
 from __future__ import annotations
 
-import functools
 import logging
 import os
 from typing import Optional
@@ -15,6 +14,11 @@ from typing import Optional
 
 class MultiProcessAdapter(logging.LoggerAdapter):
     """Reference ``MultiProcessAdapter`` (``logging.py:22-83``)."""
+
+    # (logger name, message) pairs already emitted via warning_once — module
+    # level so every adapter for the same underlying logger dedupes together
+    # (get_logger builds a fresh adapter per call).
+    _warned_once = set()
 
     @staticmethod
     def _should_log(main_process_only: bool) -> bool:
@@ -40,10 +44,18 @@ class MultiProcessAdapter(logging.LoggerAdapter):
                         self.logger.log(level, msg, *args, **kwargs)
                     state.wait_for_everyone()
 
-    @functools.lru_cache(None)
-    def warning_once(self, *args, **kwargs):
-        """Emit a given warning only once (reference ``logging.py:74-83``)."""
-        self.warning(*args, **kwargs)
+    def warning_once(self, msg, *args, **kwargs):
+        """Emit a given warning only once per process (reference ``logging.py:74-83``).
+
+        Dedupes by ``(logger name, message string)`` rather than
+        ``functools.lru_cache``: the cache keyed on ``self`` (re-warning per
+        adapter instance, and pinning every adapter alive) and raised
+        ``TypeError`` on unhashable kwargs like ``extra={...}``.
+        """
+        key = (self.logger.name, str(msg))
+        if key not in MultiProcessAdapter._warned_once:
+            MultiProcessAdapter._warned_once.add(key)
+            self.warning(msg, *args, **kwargs)
 
 
 def get_logger(name: str, log_level: Optional[str] = None) -> MultiProcessAdapter:

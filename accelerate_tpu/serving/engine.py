@@ -20,16 +20,25 @@ insert).  See ``docs/usage/serving.md``.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..logging import get_logger
 from ..models.generation import GenerationConfig
 from ..models.transformer import KVCache, Transformer
+from ..telemetry import MetricsRegistry, RecompileWatchdog, get_registry, get_tracer
 from .pool import jit_cache_sizes, make_decode_window, make_insert, make_prefill_chunk
 from .scheduler import Request, RequestState, Scheduler
+
+logger = get_logger(__name__)
+
+# Serving latencies live between ~100 us (a CPU-test decode step) and ~100 s
+# (a deep queue on a loaded pool): 24 x2 buckets from 100 us cover it.
+_LATENCY_BUCKETS = tuple(1e-4 * 2.0**i for i in range(24))
 
 
 class ServingEngine:
@@ -69,6 +78,7 @@ class ServingEngine:
         pad_token_id: int = 0,
         rng_seed: int = 0,
         slot_order: Optional[Sequence[int]] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         cfg = model.config
         self.model = model
@@ -106,9 +116,24 @@ class ServingEngine:
         # device state: the pool (per-lane index) + the batch-1 prefill scratch
         self.pool = KVCache.create(cfg, self.num_slots, self.max_len, per_lane_index=True)
         self.scratch = KVCache.create(cfg, 1, self.max_prompt_len)
-        self._decode = make_decode_window(model, self.window)
-        self._prefill = {b: make_prefill_chunk(model, b) for b in self.buckets}
-        self._insert = make_insert()
+        self.metrics = registry if registry is not None else get_registry()
+        self.tracer = get_tracer()
+        # budget=1 per executable: the engine's whole design promises exactly
+        # one compiled shape each — any second signature is a bug worth a warning
+        self._decode = RecompileWatchdog(
+            make_decode_window(model, self.window),
+            name="serve/decode_window", budget=1, registry=self.metrics,
+        )
+        self._prefill = {
+            b: RecompileWatchdog(
+                make_prefill_chunk(model, b),
+                name=f"serve/prefill_{b}", budget=1, registry=self.metrics,
+            )
+            for b in self.buckets
+        }
+        self._insert = RecompileWatchdog(
+            make_insert(), name="serve/insert", budget=1, registry=self.metrics
+        )
 
         self.scheduler = Scheduler(
             self.buckets,
@@ -132,6 +157,8 @@ class ServingEngine:
 
         self._next_rid = 0
         self._step_count = 0
+        # ``stats`` stays a plain mutable dict — benches reset it in place —
+        # while ``_bump`` mirrors every increment into cumulative counters.
         self.stats = {
             "requests_submitted": 0,
             "requests_completed": 0,
@@ -142,6 +169,27 @@ class ServingEngine:
             "occupied_lane_steps": 0,
             "slots_reused": 0,
         }
+        self._counters = {
+            k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
+        }
+        self._ttft_hist = self.metrics.histogram(
+            "serve/ttft_s", buckets=_LATENCY_BUCKETS,
+            help="submit-to-first-token wall time",
+        )
+        self._token_hist = self.metrics.histogram(
+            "serve/token_latency_s", buckets=_LATENCY_BUCKETS,
+            help="inter-token wall time (first token = TTFT)",
+        )
+        self._queue_gauge = self.metrics.gauge(
+            "serve/queue_depth", help="requests queued or mid-prefill"
+        )
+        self._occupancy_gauge = self.metrics.gauge(
+            "serve/slot_occupancy", help="fraction of slots active this window"
+        )
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self.stats[key] += n
+        self._counters[key].inc(n)
 
     # ------------------------------------------------------------- submission
     def submit(
@@ -171,11 +219,12 @@ class ServingEngine:
                 f"decode_window {self.window} = {need} exceeds slot capacity "
                 f"{self.max_len}"
             )
+        now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
-                      submit_step=self._step_count)
+                      submit_step=self._step_count, submit_time=now, last_token_time=now)
         self._next_rid += 1
         self.scheduler.submit(req)
-        self.stats["requests_submitted"] += 1
+        self._bump("requests_submitted")
         return req
 
     # -------------------------------------------------------------- admission
@@ -203,10 +252,11 @@ class ServingEngine:
             req, bucket, valid, start = took
             chunk = np.zeros(bucket, np.int32)
             chunk[:valid] = req.prompt[start:start + valid]
-            self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
+            with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
+                self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
             budget -= bucket
-            self.stats["prefill_chunks"] += 1
-            self.stats["prefill_tokens"] += valid
+            self._bump("prefill_chunks")
+            self._bump("prefill_tokens", valid)
             done = self.scheduler.finish_prefill()
             if done is not None:
                 self._install(done)
@@ -230,7 +280,7 @@ class ServingEngine:
         self._top_p[s] = 1.0 if gen.top_p is None else gen.top_p
         self._rngs[s] = np.asarray(jax.random.fold_in(self._base_rng, req.rid))
         if self._slot_ever_used[s]:
-            self.stats["slots_reused"] += 1
+            self._bump("slots_reused")
         self._slot_ever_used[s] = True
         self._slot_req[s] = req
         self._reserved_slot = None
@@ -242,27 +292,31 @@ class ServingEngine:
         self._slot_req[slot] = None
         req.state = RequestState.DONE
         req.finish_step = self._step_count
-        self.stats["requests_completed"] += 1
+        self._bump("requests_completed")
 
     def _decode_window(self) -> None:
         if not self._active.any():
             return
         n_occupied = int(self._active.sum())
-        self.pool, toks, rngs = self._decode(
-            self.params, self.pool,
-            jnp.asarray(self._pending_tok), jnp.asarray(self._active),
-            jnp.asarray(self._eos), jnp.asarray(self._do_sample),
-            jnp.asarray(self._temperature), jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
-            jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
-            jnp.asarray(self._rngs),
-        )
-        toks = np.asarray(jax.device_get(toks))
+        self._occupancy_gauge.set(n_occupied / self.num_slots)
+        with self.tracer.span("serve/decode_window", occupied=n_occupied):
+            self.pool, toks, rngs = self._decode(
+                self.params, self.pool,
+                jnp.asarray(self._pending_tok), jnp.asarray(self._active),
+                jnp.asarray(self._eos), jnp.asarray(self._do_sample),
+                jnp.asarray(self._temperature), jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
+                jnp.asarray(self._rngs),
+            )
+            toks = np.asarray(jax.device_get(toks))
         # copy: device_get hands back read-only buffers, but _install writes
         # per-slot keys into this array on admission
         self._rngs = np.array(jax.device_get(rngs), np.uint32)
-        self.stats["decode_steps"] += self.window
-        self.stats["occupied_lane_steps"] += n_occupied * self.window
+        self._bump("decode_steps", self.window)
+        self._bump("occupied_lane_steps", n_occupied * self.window)
+        now = time.perf_counter()
+        emitted: dict = {}  # rid -> (request, tokens emitted this window)
         for k in range(self.window):
             for s in range(self.num_slots):
                 req = self._slot_req[s]
@@ -270,17 +324,30 @@ class ServingEngine:
                     continue
                 tok = int(toks[s, k])
                 finishing = req.finished(tok)
+                if not req.tokens:
+                    self._ttft_hist.observe(now - req.submit_time)
                 req.emit(tok)
-                self.stats["tokens_generated"] += 1
+                emitted[req.rid] = (req, emitted.get(req.rid, (req, 0))[1] + 1)
+                self._bump("tokens_generated")
                 if finishing:
                     self._free(s, req)
                 else:
                     self._pending_tok[s] = tok
+        # a window lands W tokens per lane at once: charge each its amortized
+        # share of the wall time since the lane's previous arrival
+        for req, n_tok in emitted.values():
+            dt = max(now - req.last_token_time, 0.0) / n_tok
+            for _ in range(n_tok):
+                self._token_hist.observe(dt)
+            req.last_token_time = now
 
     # ------------------------------------------------------------------ drive
     def step(self) -> None:
         """One engine iteration: budgeted chunked-prefill admission, then one
         masked decode window over the pool."""
+        self._queue_gauge.set(
+            len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
+        )
         self._admit()
         self._decode_window()
         self._step_count += 1
@@ -289,12 +356,43 @@ class ServingEngine:
     def has_work(self) -> bool:
         return self.scheduler.has_queued or bool(self._active.any())
 
-    def run(self, max_steps: Optional[int] = None) -> None:
-        """Drive :meth:`step` until every submitted request completes."""
+    def _log_health(self, dt: float, d_tokens: int) -> None:
+        """One-line serve-health summary (the ``metrics_interval`` heartbeat)."""
+        queued = len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
+        occupancy = float(self._active.mean()) if self.num_slots else 0.0
+        p99_ms = self._token_hist.percentile(99) * 1e3
+        logger.info(
+            f"serve health: queue={queued} occupancy={occupancy:.2f} "
+            f"tokens/s={d_tokens / dt if dt > 0 else 0.0:.1f} "
+            f"token_p99={p99_ms:.2f}ms "
+            f"completed={self.stats['requests_completed']}"
+            f"/{self.stats['requests_submitted']}"
+        )
+
+    def run(
+        self,
+        max_steps: Optional[int] = None,
+        metrics_interval: Optional[float] = None,
+    ) -> None:
+        """Drive :meth:`step` until every submitted request completes.
+
+        ``metrics_interval`` (seconds) logs a one-line health summary — queue
+        depth, slot occupancy, tokens/s, p99 token latency — at that cadence
+        through :func:`~accelerate_tpu.logging.get_logger`.  Off by default.
+        """
         steps = 0
+        last_log = time.perf_counter()
+        last_tokens = self.stats["tokens_generated"]
         while self.has_work:
             self.step()
             steps += 1
+            if metrics_interval is not None:
+                now = time.perf_counter()
+                if now - last_log >= metrics_interval:
+                    self._log_health(now - last_log,
+                                     self.stats["tokens_generated"] - last_tokens)
+                    last_log = now
+                    last_tokens = self.stats["tokens_generated"]
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(f"engine did not drain in {max_steps} steps")
 
@@ -303,15 +401,17 @@ class ServingEngine:
         prompts: Sequence,
         configs=None,
         on_token: Optional[Callable[[Request, int], None]] = None,
+        metrics_interval: Optional[float] = None,
     ) -> List[Request]:
         """Convenience: submit every prompt (``configs`` is one shared or a
         per-request list of ``GenerationConfig``), run to completion, return
-        the requests in submission order."""
+        the requests in submission order.  ``metrics_interval`` is forwarded
+        to :meth:`run` (periodic health logging; off by default)."""
         reqs = []
         for i, p in enumerate(prompts):
             cfg = configs[i] if isinstance(configs, (list, tuple)) else configs
             reqs.append(self.submit(p, config=cfg, on_token=on_token))
-        self.run()
+        self.run(metrics_interval=metrics_interval)
         return reqs
 
     # ------------------------------------------------------------------ stats

@@ -49,6 +49,9 @@ class Request:
     next_chunk: int = 0
     submit_step: int = -1
     finish_step: int = -1
+    # wall-clock stamps (time.perf_counter) for TTFT / per-token latency
+    submit_time: float = 0.0
+    last_token_time: float = 0.0
 
     @property
     def done(self) -> bool:

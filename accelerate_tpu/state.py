@@ -277,7 +277,7 @@ class PartialState:
 
     def print(self, *args, **kwargs):
         if self.is_local_main_process:
-            print(*args, **kwargs)
+            print(*args, **kwargs)  # noqa: bare-print — this IS the print channel
 
     def __repr__(self):
         return (

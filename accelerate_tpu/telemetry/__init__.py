@@ -1,0 +1,61 @@
+"""Unified telemetry: metrics registry, span tracing, recompile watchdog.
+
+Zero-dependency observability for the train and serve hot paths (see
+``docs/usage/observability.md``):
+
+* :mod:`.metrics` — process-local counters/gauges/fixed-bucket histograms;
+  snapshot as a plain dict, export through the ``GeneralTracker`` roster, or
+  serve as Prometheus text exposition.
+* :mod:`.tracer` — nested wall-clock spans (``with span("phase"):``), dumped
+  as Chrome trace-event JSON (Perfetto-compatible) and mirrored into
+  ``jax.profiler.TraceAnnotation`` while a device trace is active.
+* :mod:`.watchdog` — per-callable ``(shape, dtype)`` signature accounting
+  with compile budgets: a silent retrace becomes a logged warning and a
+  gauge, not a mystery slowdown.
+
+Everything is on by default and costs nanoseconds per observation;
+``ATPU_TELEMETRY=0`` (or :func:`set_enabled` / ``get_tracer().enabled``)
+turns the hot-path hooks into single boolean checks.
+"""
+
+from .metrics import (
+    Counter,
+    DEFAULT_TIME_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    enabled,
+    exponential_buckets,
+    get_registry,
+    set_enabled,
+)
+from .tracer import (
+    Tracer,
+    device_trace_active,
+    get_tracer,
+    set_device_trace_active,
+    span,
+    trace,
+)
+from .watchdog import RecompileWatchdog, arg_signature, watch_recompiles
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_TIME_BUCKETS",
+    "exponential_buckets",
+    "get_registry",
+    "set_enabled",
+    "enabled",
+    "Tracer",
+    "get_tracer",
+    "span",
+    "trace",
+    "set_device_trace_active",
+    "device_trace_active",
+    "RecompileWatchdog",
+    "watch_recompiles",
+    "arg_signature",
+]

@@ -83,7 +83,11 @@ def build(verbose: bool = False) -> bool:
     )
     if result.returncode != 0:
         if not verbose:
-            print(result.stdout or "", result.stderr or "")
+            from ..logging import get_logger
+
+            get_logger(__name__).error(
+                f"native build failed:\n{result.stdout or ''}{result.stderr or ''}"
+            )
         return False
     _TRIED = False
     _LIB = None

@@ -87,6 +87,7 @@ def _serve_bench(args, model, cfg, params, preset):
     """
     from accelerate_tpu.models.generation import GenerationConfig, generate
     from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
 
     params = jax.device_put(params)  # HBM-resident: serving is not an offload bench
     slots = args.batch
@@ -117,9 +118,13 @@ def _serve_bench(args, model, cfg, params, preset):
         max_len,
         int(max(p + o for p, o in zip(prompt_lens, out_lens))) + window,
     )
+    # private registry: the telemetry percentiles below must cover the timed
+    # workload only, so warmup observations are wiped with the stats
+    registry = MetricsRegistry()
     eng = ServingEngine(
         model, params, num_slots=slots, max_len=slot_len,
         prefill_buckets=buckets, max_prompt_len=mp, decode_window=window,
+        registry=registry,
     )
     # warmup: one request per bucket length compiles every executable (each
     # prefill bucket, insert, the decode window) on this engine instance
@@ -127,6 +132,7 @@ def _serve_bench(args, model, cfg, params, preset):
               GenerationConfig(max_new_tokens=window))
     for k in eng.stats:
         eng.stats[k] = 0
+    registry.reset()
 
     stamps = {}
 
@@ -180,6 +186,23 @@ def _serve_bench(args, model, cfg, params, preset):
         "token_latency_p99_ms": round(1e3 * float(np.percentile(samples, 99)), 2),
         "mean_slot_occupancy": round(eng.mean_slot_occupancy(), 3),
         "compiled_executables": eng.compiled_executable_counts(),
+    }
+    # Engine-side telemetry (ISSUE: TTFT + per-token percentiles and compile
+    # counts in the bench contract).  TTFT here includes queue wait — it is
+    # submit-to-first-token as a caller observes it, not prefill time alone.
+    ttft = registry.get("serve/ttft_s").snapshot()
+    tok = registry.get("serve/token_latency_s").snapshot()
+    detail["telemetry"] = {
+        "ttft_ms": {k: round(1e3 * ttft[k], 2) for k in ("p50", "p90", "p99", "mean")},
+        "token_latency_ms": {k: round(1e3 * tok[k], 2) for k in ("p50", "p90", "p99", "mean")},
+        "compile_counts": {
+            wd.name: wd.compile_count
+            for wd in [eng._decode, eng._insert, *eng._prefill.values()]
+        },
+        "watchdog_over_budget": any(
+            wd.over_budget()
+            for wd in [eng._decode, eng._insert, *eng._prefill.values()]
+        ),
     }
     return {
         "metric": "serving_tokens_per_sec",

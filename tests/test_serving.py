@@ -238,3 +238,79 @@ class TestStreamingAndSampling:
         assert 0.0 < occ <= 1.0
         assert eng.stats["tokens_generated"] == 8
         assert eng.stats["prefill_tokens"] == 8
+
+
+class TestServingTelemetry:
+    def test_latency_histograms_and_compile_gauges(self):
+        from accelerate_tpu.telemetry import MetricsRegistry
+
+        model, params = _tiny_model()
+        rng = np.random.default_rng(11)
+        prompts = _prompts(rng, [3, 7, 5], model.config.vocab_size)
+        reg = MetricsRegistry()
+        eng = _engine(model, params, registry=reg)
+        eng.serve(prompts, GenerationConfig(max_new_tokens=4))
+        snap = reg.snapshot()
+        # one TTFT sample per request; one latency sample per generated token
+        assert snap["serve/ttft_s"]["count"] == 3
+        assert snap["serve/ttft_s"]["p99"] > 0
+        assert snap["serve/token_latency_s"]["count"] == eng.stats["tokens_generated"]
+        # counters mirror the legacy stats dict exactly
+        for key, value in eng.stats.items():
+            assert snap[f"serve/{key}_total"] == value
+        # each executable behind the watchdog compiled exactly one signature
+        assert snap["compile/serve/decode_window/count"] == 1
+        assert snap["compile/serve/insert/count"] == 1
+        assert all(
+            not wd.over_budget()
+            for wd in [eng._decode, eng._insert, *eng._prefill.values()]
+        )
+        assert 0.0 < snap["serve/slot_occupancy"] <= 1.0
+
+    def test_stats_dict_stays_resettable_in_place(self):
+        from accelerate_tpu.telemetry import MetricsRegistry
+
+        model, params = _tiny_model()
+        rng = np.random.default_rng(12)
+        reg = MetricsRegistry()
+        eng = _engine(model, params, registry=reg)
+        eng.serve(_prompts(rng, [4], model.config.vocab_size),
+                  GenerationConfig(max_new_tokens=3))
+        generated = eng.stats["tokens_generated"]
+        assert generated == 3
+        for k in eng.stats:  # the bench's warmup reset idiom must keep working
+            eng.stats[k] = 0
+        eng.serve(_prompts(rng, [5], model.config.vocab_size),
+                  GenerationConfig(max_new_tokens=3))
+        assert eng.stats["tokens_generated"] == 3
+        # registry counters are cumulative across the reset
+        assert reg.get("serve/tokens_generated_total").value == generated + 3
+
+    def test_metrics_interval_logs_health_line(self, caplog):
+        import logging
+
+        model, params = _tiny_model()
+        rng = np.random.default_rng(13)
+        from accelerate_tpu.telemetry import MetricsRegistry
+
+        eng = _engine(model, params, registry=MetricsRegistry())
+        with caplog.at_level(logging.INFO, logger="accelerate_tpu.serving.engine"):
+            eng.serve(_prompts(rng, [4, 6], model.config.vocab_size),
+                      GenerationConfig(max_new_tokens=4), metrics_interval=0.0)
+        health = [r for r in caplog.records if "serve health" in r.getMessage()]
+        assert health, "metrics_interval=0.0 should log every step"
+        assert "tokens/s=" in health[0].getMessage()
+        assert "occupancy=" in health[0].getMessage()
+
+    def test_no_health_logging_by_default(self, caplog):
+        import logging
+
+        model, params = _tiny_model()
+        rng = np.random.default_rng(14)
+        from accelerate_tpu.telemetry import MetricsRegistry
+
+        eng = _engine(model, params, registry=MetricsRegistry())
+        with caplog.at_level(logging.INFO, logger="accelerate_tpu.serving.engine"):
+            eng.serve(_prompts(rng, [4], model.config.vocab_size),
+                      GenerationConfig(max_new_tokens=3))
+        assert not [r for r in caplog.records if "serve health" in r.getMessage()]

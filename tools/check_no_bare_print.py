@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Lint: no bare ``print(`` in library code.
+
+Library output must go through ``accelerate_tpu.logging.get_logger`` (rank-
+aware, level-filtered, dedupe-capable) or ``PartialState.print`` (the
+deliberate main-process print channel) — a stray ``print`` in the train or
+serve path emits once per host process and cannot be silenced.
+
+Exempt:
+
+* ``accelerate_tpu/test_utils/`` and ``accelerate_tpu/commands/`` (CLI +
+  test harness surfaces print by design);
+* any ``__main__.py``;
+* code inside a ``main`` / ``_main`` function or an
+  ``if __name__ == "__main__":`` block (script entry points);
+* lines carrying a ``# noqa: bare-print`` pragma (e.g. ``PartialState.print``
+  itself).
+
+Exit status 1 with one ``path:line`` diagnostic per violation; 0 when clean.
+Wired into ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "accelerate_tpu"
+EXEMPT_DIRS = ("test_utils", "commands")
+ENTRY_FUNCS = ("main", "_main")
+PRAGMA = "noqa: bare-print"
+
+
+def _exempt_lines(tree: ast.Module) -> set:
+    """Line ranges inside entry-point functions / __main__ guards."""
+    lines: set = set()
+
+    def mark(node: ast.AST) -> None:
+        end = getattr(node, "end_lineno", node.lineno)
+        lines.update(range(node.lineno, end + 1))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in ENTRY_FUNCS:
+                mark(node)
+        elif isinstance(node, ast.If):
+            # if __name__ == "__main__":  (either comparison order)
+            test = node.test
+            if (
+                isinstance(test, ast.Compare)
+                and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Eq)
+            ):
+                parts = [test.left] + list(test.comparators)
+                names = [p.id for p in parts if isinstance(p, ast.Name)]
+                consts = [p.value for p in parts if isinstance(p, ast.Constant)]
+                if "__name__" in names and "__main__" in consts:
+                    mark(node)
+    return lines
+
+
+def check_file(path: Path) -> list:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:  # quality target also runs compileall; be loud
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+    exempt = _exempt_lines(tree)
+    src_lines = source.splitlines()
+    violations = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+            and node.lineno not in exempt
+            and PRAGMA not in src_lines[node.lineno - 1]
+        ):
+            rel = path.relative_to(REPO_ROOT)
+            violations.append(
+                f"{rel}:{node.lineno}: bare print() in library code — use "
+                "get_logger(__name__) or PartialState.print"
+            )
+    return violations
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel_parts = path.relative_to(PACKAGE).parts
+        if rel_parts[0] in EXEMPT_DIRS or path.name == "__main__.py":
+            continue
+        violations.extend(check_file(path))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_no_bare_print: {len(violations)} violation(s)")
+        return 1
+    print("check_no_bare_print: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
