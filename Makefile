@@ -47,3 +47,4 @@ quality:
 	python -m compileall -q accelerate_tpu
 	python tools/check_reference_citations.py
 	python tools/check_no_bare_print.py
+	python tools/check_no_method_lru_cache.py

@@ -6,11 +6,13 @@ inside a fixed set of compiled TPU executables.  See ``docs/usage/serving.md``.
 from .engine import ServingEngine
 from .pool import (
     jit_cache_sizes,
+    make_copy_chunk,
     make_decode_window,
     make_insert,
     make_prefill_chunk,
     plan_chunks,
 )
+from .prefix_cache import PrefixCache, PrefixNode, rolling_hash
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = [
@@ -18,9 +20,13 @@ __all__ = [
     "Request",
     "RequestState",
     "Scheduler",
+    "PrefixCache",
+    "PrefixNode",
+    "rolling_hash",
     "plan_chunks",
     "make_decode_window",
     "make_prefill_chunk",
     "make_insert",
+    "make_copy_chunk",
     "jit_cache_sizes",
 ]

@@ -14,7 +14,15 @@ against a fixed set of compiled executables (:mod:`.pool`):
 
 Everything dynamic lives on the host; the device only ever sees
 ``1 + len(prefill_buckets) + 1`` shapes (decode window, per-bucket prefill,
-insert).  See ``docs/usage/serving.md``.
+insert), plus ``len(prefill_buckets)`` fixed copy shapes when the prefix
+cache is enabled.  See ``docs/usage/serving.md``.
+
+Prefix caching (:mod:`.prefix_cache`): freshly prefilled full chunks are
+retained as device KV slabs in a radix tree keyed by the token prefix; later
+requests sharing that prefix replay the slabs through one
+``dynamic_update_slice`` per chunk instead of re-running prefill.  Outputs
+are token-exact with the cache on or off — only redundant prefill compute is
+skipped; the decode path never changes.
 """
 
 from __future__ import annotations
@@ -31,7 +39,14 @@ from ..logging import get_logger
 from ..models.generation import GenerationConfig
 from ..models.transformer import KVCache, Transformer
 from ..telemetry import MetricsRegistry, RecompileWatchdog, get_registry, get_tracer
-from .pool import jit_cache_sizes, make_decode_window, make_insert, make_prefill_chunk
+from .pool import (
+    jit_cache_sizes,
+    make_copy_chunk,
+    make_decode_window,
+    make_insert,
+    make_prefill_chunk,
+)
+from .prefix_cache import PrefixCache
 from .scheduler import Request, RequestState, Scheduler
 
 logger = get_logger(__name__)
@@ -63,6 +78,9 @@ class ServingEngine:
         finishing mid-window wastes at most ``window - 1`` masked lane-steps.
     slot_order: optional slot-id preference for admission (tests permute this
         to pin down lane independence).
+    prefix_cache_mb: byte budget (MiB) for the chunk-granular prefix KV cache
+        (:mod:`.prefix_cache`); ``0``/``None`` disables it.  Requests opt out
+        per-request via ``submit(..., cache_prefix=False)``.
     """
 
     def __init__(
@@ -79,6 +97,7 @@ class ServingEngine:
         rng_seed: int = 0,
         slot_order: Optional[Sequence[int]] = None,
         registry: Optional[MetricsRegistry] = None,
+        prefix_cache_mb: Optional[float] = 64.0,
     ):
         cfg = model.config
         self.model = model
@@ -134,10 +153,25 @@ class ServingEngine:
         self._insert = RecompileWatchdog(
             make_insert(), name="serve/insert", budget=1, registry=self.metrics
         )
+        if prefix_cache_mb:
+            self.prefix_cache: Optional[PrefixCache] = PrefixCache(
+                int(prefix_cache_mb * 2**20), registry=self.metrics
+            )
+            self._copy = {
+                b: RecompileWatchdog(
+                    make_copy_chunk(b),
+                    name=f"serve/copy_{b}", budget=1, registry=self.metrics,
+                )
+                for b in self.buckets
+            }
+        else:
+            self.prefix_cache = None
+            self._copy = {}
 
         self.scheduler = Scheduler(
             self.buckets,
             prefill_token_budget if prefill_token_budget is not None else self.buckets[-1],
+            prefix_cache=self.prefix_cache,
         )
 
         n = self.num_slots
@@ -168,6 +202,9 @@ class ServingEngine:
             "decode_steps": 0,
             "occupied_lane_steps": 0,
             "slots_reused": 0,
+            "prefix_hit_tokens": 0,
+            "prefix_miss_tokens": 0,
+            "cancelled": 0,
         }
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
@@ -186,6 +223,10 @@ class ServingEngine:
         self._occupancy_gauge = self.metrics.gauge(
             "serve/slot_occupancy", help="fraction of slots active this window"
         )
+        self._hit_rate_gauge = self.metrics.gauge(
+            "serve/prefix_hit_rate",
+            help="prefix_hit_tokens / (hit + miss) over cache-eligible prefill",
+        )
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
@@ -197,11 +238,14 @@ class ServingEngine:
         prompt,
         config: Optional[GenerationConfig] = None,
         on_token: Optional[Callable[[Request, int], None]] = None,
+        cache_prefix: bool = True,
         **overrides: Any,
     ) -> Request:
         """Queue one request; returns its :class:`Request` handle (filled in
         as the engine runs).  ``overrides`` patch the ``GenerationConfig``
-        exactly like :func:`~accelerate_tpu.models.generation.generate`."""
+        exactly like :func:`~accelerate_tpu.models.generation.generate`.
+        ``cache_prefix=False`` opts this request out of prefix-KV reuse and
+        population (e.g. prompts carrying secrets that must not be retained)."""
         gen = config or GenerationConfig()
         if overrides:
             gen = dataclasses.replace(gen, **overrides)
@@ -221,11 +265,26 @@ class ServingEngine:
             )
         now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
-                      submit_step=self._step_count, submit_time=now, last_token_time=now)
+                      submit_step=self._step_count, submit_time=now, last_token_time=now,
+                      cache_prefix=bool(cache_prefix))
         self._next_rid += 1
         self.scheduler.submit(req)
         self._bump("requests_submitted")
         return req
+
+    def cancel(self, request) -> bool:
+        """Cancel a still-queued request (a :class:`Request` or its rid).
+
+        Only requests that have not begun prefilling can be dropped — they
+        have burned no prefill budget and hold no slot.  Returns True when
+        the request was dequeued (state becomes ``CANCELLED``); False when it
+        is already prefilling, running, done, or unknown."""
+        rid = request.rid if isinstance(request, Request) else int(request)
+        req = self.scheduler.cancel(rid)
+        if req is None:
+            return False
+        self._bump("cancelled")
+        return True
 
     # -------------------------------------------------------------- admission
     def _next_free_slot(self) -> Optional[int]:
@@ -249,17 +308,52 @@ class ServingEngine:
             took = self.scheduler.take_chunk(budget)
             if took is None:
                 return
-            req, bucket, valid, start = took
-            chunk = np.zeros(bucket, np.int32)
-            chunk[:valid] = req.prompt[start:start + valid]
-            with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
-                self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
-            budget -= bucket
-            self._bump("prefill_chunks")
+            req, bucket, valid, start, cached = took
+            if cached:
+                # replay the retained slab: one dynamic_update_slice at the
+                # scratch index, zero budget charged (no forward pass ran)
+                node = req.cache_nodes[req.next_chunk - 1]
+                with self.tracer.span("serve/copy_chunk", bucket=bucket, start=start):
+                    self.scratch = self._copy[bucket](self.scratch, node.k, node.v)
+                self._bump("prefix_hit_tokens", valid)
+            else:
+                chunk = np.zeros(bucket, np.int32)
+                chunk[:valid] = req.prompt[start:start + valid]
+                with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
+                    self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
+                budget -= bucket
+                self._bump("prefill_chunks")
+                if self.prefix_cache is not None and req.cache_prefix:
+                    self._bump("prefix_miss_tokens", valid)
+                    self._populate_cache(req, bucket, valid, start)
             self._bump("prefill_tokens", valid)
             done = self.scheduler.finish_prefill()
             if done is not None:
                 self._install(done)
+
+    def _populate_cache(self, req: Request, bucket: int, valid: int, start: int) -> None:
+        """Retain a freshly prefilled FULL chunk in the prefix cache.
+
+        The slab slice ``scratch[:, :, start:start+bucket]`` is an eager
+        device-side copy (a handful of static offsets per geometry, never a
+        per-request shape).  Padded final chunks are skipped — their KV past
+        ``valid`` is garbage — and once one chunk fails to retain (budget or
+        collision) the rest of the request's chain is abandoned: a child
+        without its ancestors could never be matched.
+        """
+        if valid != bucket or req.cache_chain_broken:
+            return
+        parent = req.cache_nodes[-1] if req.cache_nodes else None
+        node = self.prefix_cache.insert(
+            parent, req.prompt[start:start + bucket],
+            self.scratch.k[:, :, start:start + bucket],
+            self.scratch.v[:, :, start:start + bucket],
+        )
+        if node is None:
+            req.cache_chain_broken = True
+        else:
+            self.prefix_cache.acquire([node])
+            req.cache_nodes.append(node)
 
     def _install(self, req: Request) -> None:
         """Insert a fully prefilled request into its reserved slot: one
@@ -284,6 +378,11 @@ class ServingEngine:
         self._slot_ever_used[s] = True
         self._slot_req[s] = req
         self._reserved_slot = None
+        # the slot owns a full KV copy now; the radix nodes this request read
+        # or populated can be evicted without affecting it
+        if self.prefix_cache is not None and req.cache_nodes:
+            self.prefix_cache.release(req.cache_nodes)
+            req.cache_nodes = []
         req.state = RequestState.RUNNING
 
     # ----------------------------------------------------------------- decode
@@ -349,6 +448,10 @@ class ServingEngine:
             len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
         )
         self._admit()
+        if self.prefix_cache is not None:
+            covered = self.stats["prefix_hit_tokens"] + self.stats["prefix_miss_tokens"]
+            if covered:
+                self._hit_rate_gauge.set(self.stats["prefix_hit_tokens"] / covered)
         self._decode_window()
         self._step_count += 1
 
@@ -420,11 +523,25 @@ class ServingEngine:
         total = self.stats["decode_steps"] * self.num_slots
         return self.stats["occupied_lane_steps"] / total if total else 0.0
 
+    def prefix_cache_stats(self) -> dict:
+        """Prefix-cache health: residency + hit/miss token counts (zeros when
+        the cache is disabled)."""
+        out = {"prefix_hit_tokens": self.stats["prefix_hit_tokens"],
+               "prefix_miss_tokens": self.stats["prefix_miss_tokens"]}
+        covered = out["prefix_hit_tokens"] + out["prefix_miss_tokens"]
+        out["hit_rate"] = out["prefix_hit_tokens"] / covered if covered else 0.0
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.stats())
+        return out
+
     def compiled_executable_counts(self) -> dict:
         """Per-executable jit-cache sizes — the no-retrace contract: after any
-        workload each entry is at most 1."""
+        workload each entry is at most 1 (copy entries exist only while the
+        prefix cache is enabled, and stay 0 until the first hit)."""
         out = {"decode_window": jit_cache_sizes(self._decode),
                "insert": jit_cache_sizes(self._insert)}
         for b, f in self._prefill.items():
             out[f"prefill_{b}"] = jit_cache_sizes(f)
+        for b, f in self._copy.items():
+            out[f"copy_{b}"] = jit_cache_sizes(f)
         return out

@@ -25,10 +25,15 @@ per-request retracing:
 * **insert** (:func:`make_insert`) — one executable: ``dynamic_update_slice``
   of the scratch KV into a freed slot + setting that lane's length, without
   disturbing running lanes.
+* **copy chunk** (:func:`make_copy_chunk`) — one executable per chunk bucket:
+  ``dynamic_update_slice`` of a cached prefix-KV slab (:mod:`.prefix_cache`)
+  into the scratch cache at its index — a cache hit replays retained KV
+  instead of re-running the prefill forward.
 
 Compiled-shape budget for an engine instance: ``1 (decode window) +
-len(prefill_buckets) + 1 (insert)`` — asserted by the serving tests via the
-jit cache counters.
+len(prefill_buckets) + 1 (insert)``, plus ``len(prefill_buckets)`` copy
+executables when the prefix cache is enabled — asserted by the serving tests
+via the jit cache counters.
 """
 
 from __future__ import annotations
@@ -41,6 +46,7 @@ import jax.numpy as jnp
 
 from ..models.generation import sample_tokens_batched
 from ..models.transformer import KVCache, Transformer
+from ..utils.jax_compat import jit_cache_size
 
 
 def make_decode_window(model: Transformer, window: int):
@@ -133,6 +139,31 @@ def make_insert():
     return insert_request
 
 
+def make_copy_chunk(chunk_len: int):
+    """Jitted ``(scratch, slab_k, slab_v) -> scratch``: replay one cached chunk.
+
+    The prefix-cache hit path: a retained KV slab ``[L, 1, chunk_len, H, D]``
+    (what :func:`make_prefill_chunk` computed for these tokens under this
+    exact prefix) is ``dynamic_update_slice``-d into the batch-1 scratch cache
+    at ``scratch.index`` — the same shape family as :func:`make_insert`, so
+    the compiled-shape budget grows by exactly one executable per bucket, not
+    per request.  The index advances by the full ``chunk_len`` just as a real
+    prefill of this chunk would.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def copy_chunk(scratch: KVCache, slab_k, slab_v):
+        k = jax.lax.dynamic_update_slice(
+            scratch.k, slab_k.astype(scratch.k.dtype), (0, 0, scratch.index, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            scratch.v, slab_v.astype(scratch.v.dtype), (0, 0, scratch.index, 0, 0)
+        )
+        return scratch.replace(k=k, v=v, index=scratch.index + chunk_len)
+
+    return copy_chunk
+
+
 def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int], ...]:
     """Split a prompt into prefill chunks drawn from the fixed bucket sizes.
 
@@ -156,6 +187,8 @@ def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int
 
 def jit_cache_sizes(*fns) -> int:
     """Total number of compiled executables across jitted fns — the
-    no-per-request-retrace assertion counter (`f._cache_size()` is the
-    pjit-internal miss counter; 0 until first call)."""
-    return sum(int(f._cache_size()) for f in fns)
+    no-per-request-retrace assertion counter (0 until first call).  Reads the
+    pjit-internal counter through
+    :func:`~accelerate_tpu.utils.jax_compat.jit_cache_size`, which degrades to
+    0 rather than crashing if a jax minor bump moves the private attribute."""
+    return sum(jit_cache_size(f) or 0 for f in fns)

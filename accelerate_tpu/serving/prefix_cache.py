@@ -1,0 +1,233 @@
+"""Chunk-granular prefix KV cache: a radix tree over chunk-aligned prefixes.
+
+Under a serving queue with shared system/few-shot prefixes, most prefill FLOPs
+recompute KV the pool already produced for an earlier request.  SGLang's
+RadixAttention and vLLM's automatic prefix caching reuse that KV across
+requests; the TPU-native translation caches at **chunk granularity** — the
+exact bucket boundaries :func:`~accelerate_tpu.serving.pool.plan_chunks`
+already prefills at — so reuse rides ONE fixed-shape copy executable per
+bucket (:func:`~accelerate_tpu.serving.pool.make_copy_chunk`) and the
+compiled-shape budget stays static no matter how requests share.
+
+Structure: a tree whose edges are *full* chunks of token ids.  A node's
+identity is the whole token prefix from the root; its key inside the parent is
+a rolling hash of that prefix (:func:`rolling_hash`), verified token-exact on
+every lookup so a hash collision can never serve wrong KV.  Each node retains
+the device KV slab ``[L, 1, chunk, H, D]`` (k and v) that prefill computed for
+its chunk *given its full prefix* — KV at a position depends on every earlier
+token through attention, which is why only exact whole-prefix matches are
+reusable and why partial (padded) final chunks are never cached.
+
+Lifecycle: nodes are pinned (``refs``) while any request between admission and
+slot insertion depends on them; eviction is leaf-only LRU among unpinned
+nodes, under a byte ``capacity`` (``ServingEngine(prefix_cache_mb=...)``).
+Evicting a leaf may expose its parent as the next candidate — interior nodes
+are never dropped from under their children, so every resident slab's prefix
+chain stays resident.
+
+All of this is host-side bookkeeping; the only device work a cache hit costs
+is one ``dynamic_update_slice`` per reused chunk.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..telemetry import MetricsRegistry, get_registry
+
+#: Seed for the root prefix hash (djb2's seed; any odd constant works).
+_HASH_SEED = 5381
+#: Large Mersenne prime modulus keeps the rolling hash in cheap python ints.
+_HASH_MOD = (1 << 61) - 1
+_HASH_MULT = 1_000_003
+
+
+def rolling_hash(prev: int, tokens) -> int:
+    """Extend prefix hash ``prev`` over ``tokens`` (order-sensitive).
+
+    ``rolling_hash(rolling_hash(seed, a), b) == rolling_hash(seed, a + b)`` —
+    a node's key is the hash of its *entire* prefix, computed incrementally
+    from its parent's key.
+    """
+    h = int(prev)
+    for t in np.asarray(tokens).ravel().tolist():
+        h = (h * _HASH_MULT + int(t) + 1) % _HASH_MOD
+    return h
+
+
+class PrefixNode:
+    """One cached chunk: token ids + the retained device KV slab."""
+
+    __slots__ = ("key", "tokens", "parent", "children", "k", "v", "nbytes",
+                 "refs", "last_used")
+
+    def __init__(self, key: int, tokens: Optional[np.ndarray], parent, k, v):
+        self.key = key
+        self.tokens = tokens                 # [chunk] int32; None for the root
+        self.parent = parent
+        self.children: Dict[int, "PrefixNode"] = {}
+        self.k = k                           # [L, 1, chunk, H, D] device slab
+        self.v = v
+        self.nbytes = (int(k.nbytes) + int(v.nbytes)) if k is not None else 0
+        self.refs = 0
+        self.last_used = 0
+
+    def __repr__(self) -> str:  # debugging aid only
+        n = 0 if self.tokens is None else len(self.tokens)
+        return (f"PrefixNode(len={n}, refs={self.refs}, "
+                f"children={len(self.children)}, bytes={self.nbytes})")
+
+
+class PrefixCache:
+    """Host-managed radix cache of device KV slabs with LRU byte budgeting.
+
+    Parameters
+    ----------
+    capacity_bytes: retained-slab budget.  Pinned (``refs > 0``) nodes never
+        evict, so in-flight requests can transiently hold the cache over
+        budget; eviction restores it as soon as pins release.
+    registry: metrics registry for the ``serve/prefix_cache_*`` gauges and the
+        eviction counter (default: the process registry).
+    """
+
+    def __init__(self, capacity_bytes: int,
+                 registry: Optional[MetricsRegistry] = None):
+        self.capacity = int(capacity_bytes)
+        if self.capacity <= 0:
+            raise ValueError(f"capacity_bytes must be positive, got {capacity_bytes}")
+        self.root = PrefixNode(_HASH_SEED, None, None, None, None)
+        self.bytes = 0
+        self.evictions = 0
+        self._nodes: List[PrefixNode] = []
+        self._clock = 0
+        registry = registry if registry is not None else get_registry()
+        self._bytes_gauge = registry.gauge(
+            "serve/prefix_cache_bytes", help="retained prefix KV slab bytes"
+        )
+        self._nodes_gauge = registry.gauge(
+            "serve/prefix_cache_nodes", help="resident prefix cache nodes"
+        )
+        self._evict_counter = registry.counter(
+            "serve/prefix_cache_evictions_total",
+            help="prefix cache nodes dropped by LRU eviction",
+        )
+
+    # ---------------------------------------------------------------- lookup
+    def _touch(self, node: PrefixNode) -> None:
+        self._clock += 1
+        node.last_used = self._clock
+
+    def match(self, prompt: np.ndarray,
+              chunks: Sequence[Tuple[int, int]]) -> List[PrefixNode]:
+        """Longest chain of cached nodes covering ``prompt``'s leading chunks.
+
+        Walks ``chunks`` (the request's :func:`plan_chunks` plan) from the
+        root; stops at the first partial chunk (``valid < bucket`` — padded
+        chunks are never cached) or the first miss.  Matched nodes are
+        LRU-touched but NOT pinned — callers pin via :meth:`acquire`.
+        """
+        prompt = np.asarray(prompt)
+        nodes: List[PrefixNode] = []
+        node, start = self.root, 0
+        for bucket, valid in chunks:
+            if valid != bucket:
+                break
+            tokens = prompt[start:start + bucket]
+            child = node.children.get(rolling_hash(node.key, tokens))
+            if child is None or not np.array_equal(child.tokens, tokens):
+                break
+            self._touch(child)
+            nodes.append(child)
+            node, start = child, start + bucket
+        return nodes
+
+    # --------------------------------------------------------------- pinning
+    def acquire(self, nodes: Iterable[PrefixNode]) -> None:
+        """Pin ``nodes`` against eviction (a request depends on their slabs)."""
+        for n in nodes:
+            n.refs += 1
+
+    def release(self, nodes: Iterable[PrefixNode]) -> None:
+        """Drop pins taken by :meth:`acquire`; touched so fresh users rank hot."""
+        for n in nodes:
+            n.refs -= 1
+            if n.refs < 0:
+                raise RuntimeError(f"prefix cache refcount underflow on {n!r}")
+            self._touch(n)
+
+    # -------------------------------------------------------------- mutation
+    def insert(self, parent: Optional[PrefixNode], tokens, k, v
+               ) -> Optional[PrefixNode]:
+        """Retain one freshly prefilled chunk under ``parent`` (None = root).
+
+        Returns the resident node — the existing one if this exact chunk is
+        already cached — or ``None`` when it cannot be retained (the byte
+        budget cannot be met even after eviction, or a hash collision with a
+        different token sequence occupies the key; both leave the cache
+        untouched, and the caller must then stop extending this chain).
+        """
+        parent = parent if parent is not None else self.root
+        tokens = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        key = rolling_hash(parent.key, tokens)
+        existing = parent.children.get(key)
+        if existing is not None:
+            if np.array_equal(existing.tokens, tokens):
+                self._touch(existing)
+                return existing
+            return None  # 61-bit hash collision: keep the resident entry
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        if not self._make_room(nbytes):
+            return None
+        node = PrefixNode(key, tokens, parent, k, v)
+        self._touch(node)
+        parent.children[key] = node
+        self._nodes.append(node)
+        self.bytes += nbytes
+        self._bytes_gauge.set(self.bytes)
+        self._nodes_gauge.set(len(self._nodes))
+        return node
+
+    def _make_room(self, nbytes: int) -> bool:
+        """Evict LRU unpinned leaves until ``nbytes`` more fits; False if the
+        survivors (pinned or interior) can't shrink far enough."""
+        if nbytes > self.capacity:
+            return False
+        while self.bytes + nbytes > self.capacity:
+            victim = None
+            for n in self._nodes:
+                if n.children or n.refs > 0:
+                    continue
+                if victim is None or n.last_used < victim.last_used:
+                    victim = n
+            if victim is None:
+                return False
+            self._remove(victim)
+        return True
+
+    def _remove(self, node: PrefixNode) -> None:
+        del node.parent.children[node.key]
+        self._nodes.remove(node)
+        self.bytes -= node.nbytes
+        self.evictions += 1
+        self._evict_counter.inc()
+        self._bytes_gauge.set(self.bytes)
+        self._nodes_gauge.set(len(self._nodes))
+
+    # ----------------------------------------------------------------- stats
+    @property
+    def num_nodes(self) -> int:
+        return len(self._nodes)
+
+    def stats(self) -> Dict[str, Any]:
+        """Plain-dict snapshot for the engine's legacy stats surface."""
+        return {
+            "capacity_bytes": self.capacity,
+            "bytes": self.bytes,
+            "nodes": len(self._nodes),
+            "evictions": self.evictions,
+        }
+
+
+__all__ = ["PrefixCache", "PrefixNode", "rolling_hash"]

@@ -6,6 +6,15 @@ scheduler is everything dynamic: a FCFS request queue, per-request
 progress, and an admission policy bounded by a **prefill-token budget per
 engine step** — the Orca/Sarathi knob that keeps decode-step latency jitter
 bounded while new prompts stream in.
+
+With a :class:`~accelerate_tpu.serving.prefix_cache.PrefixCache` attached, the
+scheduler also resolves prefix reuse: ``submit`` walks the radix tree for the
+longest cached chunk-aligned prefix (pinning the matched nodes so eviction
+cannot pull them out from under the queued request), ``start_next`` refreshes
+the walk — requests admitted earlier may have populated chunks this request
+can now reuse — and ``take_chunk`` charges cached chunks at ZERO cost against
+the prefill-token budget, so every hit also frees budget for cold prompts in
+the same engine step.
 """
 
 from __future__ import annotations
@@ -13,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 from collections import deque
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -26,6 +35,7 @@ class RequestState(enum.Enum):
     PREFILL = "prefill"
     RUNNING = "running"
     DONE = "done"
+    CANCELLED = "cancelled"
 
 
 @dataclasses.dataclass
@@ -47,6 +57,16 @@ class Request:
     # chunked-prefill progress
     chunks: Tuple[Tuple[int, int], ...] = ()
     next_chunk: int = 0
+    # prefix-cache state: the first ``cached_chunks`` entries of ``chunks``
+    # are CACHED (replayed from retained KV slabs instead of prefilled);
+    # ``cache_nodes`` holds the pinned radix nodes backing them plus any nodes
+    # this request itself populates (released on insertion or cancel), and
+    # ``cache_chain_broken`` stops population once a chunk could not be
+    # retained (a later chunk without its ancestors would be unreachable).
+    cache_prefix: bool = True
+    cached_chunks: int = 0
+    cache_nodes: List[Any] = dataclasses.field(default_factory=list)
+    cache_chain_broken: bool = False
     submit_step: int = -1
     finish_step: int = -1
     # wall-clock stamps (time.perf_counter) for TTFT / per-token latency
@@ -84,7 +104,8 @@ class Scheduler:
     its whole prefill (chunked prefill, Sarathi-style).
     """
 
-    def __init__(self, prefill_buckets: Sequence[int], prefill_token_budget: int):
+    def __init__(self, prefill_buckets: Sequence[int], prefill_token_budget: int,
+                 prefix_cache=None):
         self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
         if not self.buckets:
             raise ValueError("need at least one prefill bucket")
@@ -96,10 +117,45 @@ class Scheduler:
             )
         self.queue: deque = deque()
         self.prefilling: Optional[Request] = None
+        self.prefix_cache = prefix_cache
+
+    def _match_prefix(self, request: Request) -> None:
+        """(Re)walk the radix tree for ``request``'s longest cached prefix and
+        pin the matched chain.  Pins taken by an earlier walk are released
+        *after* the new chain is acquired — the old nodes are still resident
+        during the re-walk, so the fresh match can only be equal or longer."""
+        if self.prefix_cache is None or not request.cache_prefix:
+            return
+        nodes = self.prefix_cache.match(request.prompt, request.chunks)
+        self.prefix_cache.acquire(nodes)
+        if request.cache_nodes:
+            self.prefix_cache.release(request.cache_nodes)
+        request.cache_nodes = list(nodes)
+        request.cached_chunks = len(nodes)
 
     def submit(self, request: Request) -> None:
         request.chunks = plan_chunks(len(request.prompt), self.buckets)
+        self._match_prefix(request)
         self.queue.append(request)
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Drop a still-QUEUED request (not yet prefilling) from the queue.
+
+        Returns the cancelled :class:`Request` (state ``CANCELLED``, its
+        pinned prefix-cache nodes released) or ``None`` when ``rid`` is not
+        queued — already prefilling, running, done, or unknown.  Cancelling
+        before admission is the cheap case worth optimizing: the request has
+        consumed no prefill budget and holds no slot.
+        """
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                del self.queue[i]
+                if self.prefix_cache is not None and req.cache_nodes:
+                    self.prefix_cache.release(req.cache_nodes)
+                    req.cache_nodes = []
+                req.state = RequestState.CANCELLED
+                return req
+        return None
 
     @property
     def has_queued(self) -> bool:
@@ -116,21 +172,31 @@ class Scheduler:
         req = self.queue.popleft()
         req.state = RequestState.PREFILL
         req.slot = slot
+        # refresh the prefix match: requests admitted since submit may have
+        # populated exactly the chunks this one needs (the batch-submit case)
+        self._match_prefix(req)
         self.prefilling = req
         return req
 
-    def take_chunk(self, budget: int) -> Optional[Tuple[Request, int, int, int]]:
+    def take_chunk(self, budget: int) -> Optional[Tuple[Request, int, int, int, bool]]:
         """Next prefill chunk fitting ``budget``:
-        ``(request, bucket_len, valid_len, start)`` or None."""
+        ``(request, bucket_len, valid_len, start, cached)`` or None.
+
+        A CACHED chunk (``cached=True``: covered by a pinned prefix-cache
+        node) charges nothing against the budget — replaying retained KV is
+        one ``dynamic_update_slice``, not a forward pass — so hits both skip
+        compute and leave the whole budget to cold prompts this step.
+        """
         req = self.prefilling
         if req is None or req.next_chunk >= len(req.chunks):
             return None
         bucket, valid = req.chunks[req.next_chunk]
-        if bucket > budget:
+        cached = req.next_chunk < req.cached_chunks
+        if not cached and bucket > budget:
             return None
         start = sum(v for _, v in req.chunks[: req.next_chunk])
         req.next_chunk += 1
-        return req, bucket, valid, start
+        return req, bucket, valid, start, cached
 
     def finish_prefill(self) -> Optional[Request]:
         """If the in-flight request has prefilled every chunk, hand it over

@@ -124,6 +124,14 @@ class RecompileWatchdog:
             )
         return out
 
+    def jit_cache_size(self) -> Optional[int]:
+        """Wrapped fn's compiled-executable count, via the jax_compat probe
+        (None when this jax hides the counter) — prefer this over touching
+        the forwarded ``_cache_size`` internal directly."""
+        from ..utils.jax_compat import jit_cache_size
+
+        return jit_cache_size(self._fn)
+
     def __getattr__(self, attr):
         # forward pjit internals (_cache_size, lower, ...) to the wrapped fn
         if attr == "_fn":  # guard pre-__init__ lookups from recursing

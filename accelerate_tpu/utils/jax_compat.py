@@ -7,9 +7,17 @@ lines.  The host-offload paths were written against ``jax.memory.Space``
 
 (The matching ``shard_map`` shim lives in ``parallel/mesh.py`` next to its
 call sites.)
+
+Also here: :func:`jit_cache_size`, the one sanctioned reader of the private
+pjit compiled-executable counter (``f._cache_size()``) that the serving
+compiled-shape assertions and the telemetry recompile watchdog rely on — the
+attribute is internal and has no stability promise, so every consumer goes
+through this probe instead of touching it directly.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 try:  # jax >= 0.6
     from jax.memory import Space  # type: ignore[import-not-found]
@@ -27,11 +35,58 @@ except ImportError:  # jax 0.4.x
         except Exception:
             return False
 
-    class Space:  # type: ignore[no-redef]
+    class _SpaceMeta(type):
+        # Resolving the attributes needs jax.devices(), which initializes the
+        # runtime backend — fatal for anyone importing this module before
+        # jax.distributed.initialize() (the debug_launcher workers).  Defer
+        # the probe to first attribute access instead of class creation.
+        _kinds = {"Device": "device", "Host": "pinned_host"}
+
+        def __getattr__(cls, name):
+            try:
+                kind = cls._kinds[name]
+            except KeyError:
+                raise AttributeError(name) from None
+            value = _Transfer(kind) if _has_host_memory() else None
+            setattr(cls, name, value)
+            return value
+
+    class Space(metaclass=_SpaceMeta):  # type: ignore[no-redef]
         """0.4.x stand-in: attributes are in-jit ``device_put`` destinations."""
 
-        Device = _Transfer("device") if _has_host_memory() else None
-        Host = _Transfer("pinned_host") if _has_host_memory() else None
+
+# pjit-internal spellings of the compiled-executable counter, newest first.
+_CACHE_SIZE_ATTRS = ("_cache_size",)
 
 
-__all__ = ["Space"]
+def jit_cache_size(fn) -> Optional[int]:
+    """Compiled-executable count of a jitted callable, or ``None`` if unknown.
+
+    jax 0.4-0.7 expose the per-function executable-cache size as the private
+    ``f._cache_size()`` (0 until the first call).  Wrappers that forward
+    attribute access to a wrapped jitted fn (the telemetry
+    ``RecompileWatchdog``) work transparently.  When no known probe exists —
+    a jax minor bump renamed the internal — this returns ``None`` instead of
+    raising, so callers degrade to watchdog-signature counting rather than
+    crashing the serving path; exact-count test assertions should skip via
+    :func:`jit_cache_supported`.
+    """
+    for attr in _CACHE_SIZE_ATTRS:
+        probe = getattr(fn, attr, None)
+        if probe is None:
+            continue
+        try:
+            return int(probe() if callable(probe) else probe)
+        except Exception:
+            continue
+    return None
+
+
+def jit_cache_supported() -> bool:
+    """True when this jax exposes a readable executable-cache counter."""
+    import jax
+
+    return jit_cache_size(jax.jit(lambda x: x)) is not None
+
+
+__all__ = ["Space", "jit_cache_size", "jit_cache_supported"]

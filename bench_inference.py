@@ -74,6 +74,65 @@ def _presets():
     }
 
 
+def _shared_prefix_result(args, preset, shared, prompt_lens, out_lens,
+                          useful_tokens, run_engine, eng, reqs, dt_on,
+                          registry, samples, buckets, slots, window):
+    """Cache-on vs cache-off on the shared-prefix workload (one JSON result).
+
+    The cache-off engine is the baseline — identical requests, identical
+    executables minus the copies — so ``vs_baseline`` isolates exactly what
+    prefix reuse buys.  Outputs must be token-identical between the runs (the
+    cache skips compute, never changes it); the bench hard-fails otherwise.
+    """
+    eng_off, reqs_off, dt_off, registry_off, _ = run_engine(0)
+    if [q.tokens for q in reqs] != [q.tokens for q in reqs_off]:
+        raise SystemExit(
+            "prefix cache changed outputs: cache-on tokens differ from "
+            "cache-off on the same workload"
+        )
+    tps_on = useful_tokens / dt_on
+    tps_off = useful_tokens / dt_off
+    hit = eng.stats["prefix_hit_tokens"]
+    miss = eng.stats["prefix_miss_tokens"]
+    ttft_on = registry.get("serve/ttft_s").snapshot()
+    ttft_off = registry_off.get("serve/ttft_s").snapshot()
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": args.requests,
+        "num_slots": slots,
+        "decode_window": window,
+        "prefill_buckets": list(buckets),
+        "shared_prefix": shared,
+        "prefix_cache_mb": args.prefix_cache_mb,
+        "prompt_len_p50_max": [int(np.median(prompt_lens)), int(prompt_lens.max())],
+        "out_len_p50_max": [int(np.median(out_lens)), int(out_lens.max())],
+        "useful_tokens": useful_tokens,
+        "engine_wall_s": round(dt_on, 3),
+        "cache_off_wall_s": round(dt_off, 3),
+        "cache_off_tokens_per_s": round(tps_off, 2),
+        "prefix_hit_rate": round(hit / (hit + miss), 3) if hit + miss else 0.0,
+        "prefix_hit_tokens": hit,
+        "prefix_cache": eng.prefix_cache_stats(),
+        "outputs_token_identical": True,
+        "token_latency_p50_ms": round(1e3 * float(np.percentile(samples, 50)), 2),
+        "token_latency_p99_ms": round(1e3 * float(np.percentile(samples, 99)), 2),
+        "ttft_ms": {k: round(1e3 * ttft_on[k], 2) for k in ("p50", "p90", "p99", "mean")},
+        "cache_off_ttft_ms": {
+            k: round(1e3 * ttft_off[k], 2) for k in ("p50", "p90", "p99", "mean")
+        },
+        "mean_slot_occupancy": round(eng.mean_slot_occupancy(), 3),
+        "compiled_executables": eng.compiled_executable_counts(),
+    }
+    return {
+        "metric": "serving_prefix_cache_tokens_per_sec",
+        "value": round(tps_on, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_on / tps_off, 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -84,6 +143,13 @@ def _serve_bench(args, model, cfg, params, preset):
     timing, exactly how ``generate`` would serve this queue.  The engine
     serves the same queue through the slot pool with chunked prefill and
     in-flight admission.
+
+    ``--shared-prefix N`` switches to the prefix-caching workload: every
+    prompt is one common N-token system prefix plus a per-request log-normal
+    suffix.  The baseline becomes the SAME engine with the prefix cache off
+    (``vs_baseline`` = cache-on tokens/s over cache-off tokens/s on identical
+    requests), outputs are asserted token-identical between the two runs, and
+    ``detail.prefix_hit_rate`` records the reuse the radix cache found.
     """
     from accelerate_tpu.models.generation import GenerationConfig, generate
     from accelerate_tpu.serving import ServingEngine
@@ -101,13 +167,31 @@ def _serve_bench(args, model, cfg, params, preset):
     # slot capacity
     r = np.random.default_rng(args.serve_seed)
     out_cap = min(max_len - window - mp, 2 * mp)
-    prompt_lens = np.clip(
-        np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, args.requests)), 4, mp
-    ).astype(int)
+    shared = int(args.shared_prefix or 0)
+    if shared:
+        if shared > mp - 4:
+            raise SystemExit(
+                f"--shared-prefix {shared} leaves no room for per-request "
+                f"suffixes (max admissible prompt is {mp})"
+            )
+        common = r.integers(1, cfg.vocab_size, (shared,)).astype(np.int32)
+        suffix_lens = np.clip(
+            np.rint(r.lognormal(np.log(max(4, (mp - shared) // 3)), 0.8, args.requests)),
+            2, mp - shared,
+        ).astype(int)
+        prompt_lens = shared + suffix_lens
+        prompts = [
+            np.concatenate([common, r.integers(1, cfg.vocab_size, (int(n),)).astype(np.int32)])
+            for n in suffix_lens
+        ]
+    else:
+        prompt_lens = np.clip(
+            np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, args.requests)), 4, mp
+        ).astype(int)
+        prompts = [r.integers(1, cfg.vocab_size, (int(n),)).astype(np.int32) for n in prompt_lens]
     out_lens = np.clip(
         np.rint(r.lognormal(np.log(max(8, out_cap // 8)), 1.0, args.requests)), 4, out_cap
     ).astype(int)
-    prompts = [r.integers(1, cfg.vocab_size, (int(n),)).astype(np.int32) for n in prompt_lens]
     gens = [GenerationConfig(max_new_tokens=int(n)) for n in out_lens]
     useful_tokens = int(out_lens.sum())
 
@@ -118,36 +202,57 @@ def _serve_bench(args, model, cfg, params, preset):
         max_len,
         int(max(p + o for p, o in zip(prompt_lens, out_lens))) + window,
     )
-    # private registry: the telemetry percentiles below must cover the timed
-    # workload only, so warmup observations are wiped with the stats
-    registry = MetricsRegistry()
-    eng = ServingEngine(
-        model, params, num_slots=slots, max_len=slot_len,
-        prefill_buckets=buckets, max_prompt_len=mp, decode_window=window,
-        registry=registry,
+
+    def run_engine(prefix_mb):
+        """One warmed, timed engine pass over the workload.
+
+        A private registry per run: the telemetry percentiles must cover the
+        timed workload only, so warmup observations are wiped with the stats.
+        """
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            model, params, num_slots=slots, max_len=slot_len,
+            prefill_buckets=buckets, max_prompt_len=mp, decode_window=window,
+            registry=registry, prefix_cache_mb=prefix_mb,
+        )
+        # warmup: one request per bucket length compiles every executable
+        # (each prefill bucket, insert, the decode window); with the cache on,
+        # a duplicate of each drives one hit through every copy executable so
+        # the timed region never pays a compile
+        warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets]
+        if prefix_mb:
+            warm = warm + [w.copy() for w in warm]
+        eng.serve(warm, GenerationConfig(max_new_tokens=window))
+        for k in eng.stats:
+            eng.stats[k] = 0
+        registry.reset()
+
+        stamps = {}
+
+        def on_token(req, tok):
+            stamps.setdefault(req.rid, []).append(time.perf_counter())
+
+        t0 = time.perf_counter()
+        reqs = eng.serve(prompts, gens, on_token=on_token)
+        dt = time.perf_counter() - t0
+        # per-token latency samples at decode-window granularity, queue wait
+        # included (what a caller actually observes)
+        samples = np.concatenate(
+            [np.diff(np.asarray([t0] + stamps[req.rid])) for req in reqs]
+        )
+        return eng, reqs, dt, registry, samples
+
+    eng, reqs, dt_engine, registry, samples = run_engine(
+        args.prefix_cache_mb if shared else 0
     )
-    # warmup: one request per bucket length compiles every executable (each
-    # prefill bucket, insert, the decode window) on this engine instance
-    eng.serve([r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets],
-              GenerationConfig(max_new_tokens=window))
-    for k in eng.stats:
-        eng.stats[k] = 0
-    registry.reset()
-
-    stamps = {}
-
-    def on_token(req, tok):
-        stamps.setdefault(req.rid, []).append(time.perf_counter())
-
-    t0 = time.perf_counter()
-    reqs = eng.serve(prompts, gens, on_token=on_token)
-    dt_engine = time.perf_counter() - t0
     engine_tps = useful_tokens / dt_engine
-    # per-token latency samples at decode-window granularity, queue wait
-    # included (what a caller actually observes)
-    samples = np.concatenate(
-        [np.diff(np.asarray([t0] + stamps[req.rid])) for req in reqs]
-    )
+
+    if shared:
+        return _shared_prefix_result(
+            args, preset, shared, prompt_lens, out_lens, useful_tokens,
+            run_engine, eng, reqs, dt_engine, registry, samples, buckets, slots,
+            window,
+        )
 
     # static baseline: FCFS groups of `slots`, padded to the workload max —
     # one compiled (prompt, new_tokens) shape for every group
@@ -223,6 +328,14 @@ def main():
                         help="serve task: decode steps fused per engine iteration")
     parser.add_argument("--serve_seed", type=int, default=0,
                         help="serve task: workload RNG seed")
+    parser.add_argument("--shared-prefix", dest="shared_prefix", type=int, default=0,
+                        help="serve task: common system-prompt length shared by "
+                             "every request (0 = off); benches the prefix KV "
+                             "cache against a cache-off run of the same workload")
+    parser.add_argument("--prefix-cache-mb", dest="prefix_cache_mb", type=float,
+                        default=64.0,
+                        help="serve task: prefix KV cache byte budget (MiB) for "
+                             "the --shared-prefix run")
     parser.add_argument("--preset", choices=list(presets), default=None,
                         help="default: small on TPU, tiny elsewhere (gpt2-xl = parity geometry)")
     parser.add_argument("--batch", type=int, default=8)

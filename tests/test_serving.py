@@ -16,8 +16,11 @@ import pytest
 
 from accelerate_tpu.models.generation import GenerationConfig, generate
 from accelerate_tpu.models.transformer import KVCache, Transformer, TransformerConfig
-from accelerate_tpu.serving import ServingEngine, RequestState
+from accelerate_tpu.serving import PrefixCache, ServingEngine, RequestState
 from accelerate_tpu.serving.pool import plan_chunks
+from accelerate_tpu.serving.prefix_cache import rolling_hash
+from accelerate_tpu.telemetry import MetricsRegistry
+from accelerate_tpu.utils.jax_compat import jit_cache_supported
 
 
 def _tiny_model(seed=0, **kw):
@@ -165,7 +168,10 @@ class TestCompiledShapes:
         eng = _engine(model, params, num_slots=2)
         eng.serve(prompts, gens)
         counts = eng.compiled_executable_counts()
-        assert counts == {"decode_window": 1, "insert": 1, "prefill_4": 1, "prefill_8": 1}
+        # copy executables exist (prefix cache on by default) but stay
+        # uncompiled: random prompts share no prefixes
+        assert counts == {"decode_window": 1, "insert": 1, "prefill_4": 1,
+                          "prefill_8": 1, "copy_4": 0, "copy_8": 0}
 
     def test_mixed_sampling_configs_share_decode_executable(self):
         """Per-request knobs (greedy vs sampled, different temps/top-k/eos)
@@ -314,3 +320,226 @@ class TestServingTelemetry:
             eng.serve(_prompts(rng, [4], model.config.vocab_size),
                       GenerationConfig(max_new_tokens=3))
         assert not [r for r in caplog.records if "serve health" in r.getMessage()]
+
+
+def _slab(chunk, fill=0.0):
+    """A tiny fake KV slab [L=2, 1, chunk, H=2, D=4]: 64*chunk bytes each."""
+    return np.full((2, 1, chunk, 2, 4), fill, np.float32)
+
+
+class TestPrefixCacheUnit:
+    """Radix-tree mechanics in isolation: numpy slabs, no engine, no device."""
+
+    def test_rolling_hash_composes(self):
+        a, b = np.arange(4, dtype=np.int32), np.arange(4, 9, dtype=np.int32)
+        assert rolling_hash(rolling_hash(1, a), b) == rolling_hash(1, np.concatenate([a, b]))
+        assert rolling_hash(1, a) != rolling_hash(1, a[::-1].copy())
+
+    def test_match_insert_roundtrip_and_partial_chunks(self):
+        cache = PrefixCache(1 << 20, registry=MetricsRegistry())
+        prompt = np.arange(1, 13, dtype=np.int32)           # 12 tokens
+        chunks = plan_chunks(12, (4, 8))                    # ((8, 8), (4, 4))
+        assert cache.match(prompt, chunks) == []
+        n1 = cache.insert(None, prompt[:8], _slab(8), _slab(8))
+        n2 = cache.insert(n1, prompt[8:12], _slab(4), _slab(4))
+        assert [n1, n2] == cache.match(prompt, chunks)
+        # an 11-token prompt shares only the full first chunk: (8,8),(4,3)
+        assert cache.match(prompt[:11], plan_chunks(11, (4, 8))) == [n1]
+        # same tokens, different alignment: a (4,4) head chunk is a miss
+        assert cache.match(prompt[:4], plan_chunks(4, (4, 8))) == []
+        # re-inserting an already-resident chunk returns the existing node
+        assert cache.insert(n1, prompt[8:12], _slab(4), _slab(4)) is n2
+        assert cache.num_nodes == 2
+
+    def test_lru_eviction_under_tiny_budget(self):
+        slab_bytes = 2 * _slab(4).nbytes                    # k + v = 1024
+        cache = PrefixCache(2 * slab_bytes, registry=MetricsRegistry())
+        ta = np.arange(0, 4, dtype=np.int32)
+        tb = np.arange(4, 8, dtype=np.int32)
+        tc = np.arange(8, 12, dtype=np.int32)
+        a = cache.insert(None, ta, _slab(4), _slab(4))
+        assert cache.insert(None, tb, _slab(4), _slab(4)) is not None
+        cache.match(ta, ((4, 4),))                          # touch a: b is now LRU
+        assert cache.insert(None, tc, _slab(4), _slab(4)) is not None
+        assert cache.evictions == 1 and cache.num_nodes == 2
+        assert cache.match(ta, ((4, 4),)) == [a]            # survived
+        assert cache.match(tb, ((4, 4),)) == []             # evicted
+        # a slab larger than the whole budget is refused outright
+        assert cache.insert(None, np.arange(32, dtype=np.int32),
+                            _slab(32), _slab(32)) is None
+
+    def test_refcount_pins_mid_prefill_hit(self):
+        """A pinned node (a request mid-prefill depends on its slab) never
+        evicts, even as fresh inserts churn everything unpinned around it."""
+        slab_bytes = 2 * _slab(4).nbytes
+        cache = PrefixCache(2 * slab_bytes, registry=MetricsRegistry())
+        ta = np.arange(0, 4, dtype=np.int32)
+        a = cache.insert(None, ta, _slab(4), _slab(4))
+        cache.acquire([a])                                  # hit is mid-prefill
+        for i in range(1, 4):                               # churn: b, c, d
+            t = np.arange(4 * i, 4 * i + 4, dtype=np.int32)
+            assert cache.insert(None, t, _slab(4), _slab(4)) is not None
+        assert cache.match(ta, ((4, 4),)) == [a]            # pinned throughout
+        cache.release([a])
+        # release also LRU-touched it, so one more insert evicts the OTHER node
+        assert cache.insert(None, np.arange(40, 44, dtype=np.int32),
+                            _slab(4), _slab(4)) is not None
+        assert cache.match(ta, ((4, 4),)) == [a]
+        with pytest.raises(RuntimeError, match="underflow"):
+            cache.release([a])
+
+    def test_interior_nodes_never_evict_before_leaves(self):
+        slab_bytes = 2 * _slab(4).nbytes
+        cache = PrefixCache(3 * slab_bytes, registry=MetricsRegistry())
+        prompt = np.arange(0, 8, dtype=np.int32)
+        parent = cache.insert(None, prompt[:4], _slab(4), _slab(4))
+        child = cache.insert(parent, prompt[4:], _slab(4), _slab(4))
+        cache.match(prompt[:4], ((4, 4),))                  # parent is MRU, child LRU
+        assert cache.insert(None, np.arange(20, 28, dtype=np.int32),
+                            _slab(8), _slab(8)) is not None
+        # the leaf went, not the (older-but-interior would break the chain) parent
+        assert cache.match(prompt, ((4, 4), (4, 4))) == [parent]
+        assert child not in cache._nodes
+
+
+class TestPrefixCacheEngine:
+    """End-to-end: reuse must be invisible in outputs and visible in stats."""
+
+    def _shared_workload(self, model, rng, shared_len=8):
+        vocab = model.config.vocab_size
+        shared = rng.integers(1, vocab, (shared_len,)).astype(np.int32)
+        warm = [np.concatenate([shared, s]) for s in _prompts(rng, [3, 5, 2], vocab)]
+        cold = _prompts(rng, [5, 9], vocab)
+        return shared, warm, cold
+
+    def test_token_exact_cache_on_vs_off_mixed_shared_cold(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(21)
+        shared, warm, cold = self._shared_workload(model, rng)
+        prompts = [warm[0], cold[0], warm[1], cold[1], warm[2]]
+        gens = [GenerationConfig(max_new_tokens=n) for n in (6, 5, 7, 4, 6)]
+        eng_on = _engine(model, params, prefix_cache_mb=16)
+        eng_off = _engine(model, params, prefix_cache_mb=0)
+        reqs_on = eng_on.serve(prompts, gens)
+        reqs_off = eng_off.serve(prompts, gens)
+        for r_on, r_off, prompt, gen in zip(reqs_on, reqs_off, prompts, gens):
+            assert r_on.tokens == r_off.tokens == _expected(model, params, prompt, gen)
+        # warm[1] and warm[2] each replayed the shared 8-token chunk
+        assert eng_on.stats["prefix_hit_tokens"] == 16
+        assert eng_on.stats["prefix_hit_tokens"] + eng_on.stats["prefix_miss_tokens"] \
+            == eng_on.stats["prefill_tokens"]
+        assert eng_off.stats["prefix_hit_tokens"] == 0
+        assert eng_off.prefix_cache is None
+        stats = eng_on.prefix_cache_stats()
+        assert 0.0 < stats["hit_rate"] < 1.0 and stats["nodes"] > 0
+
+    def test_cache_prefix_opt_out(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(22)
+        shared, warm, _ = self._shared_workload(model, rng)
+        eng = _engine(model, params)
+        gen = GenerationConfig(max_new_tokens=4)
+        reqs = [eng.submit(warm[0], config=gen),
+                eng.submit(warm[1], config=gen, cache_prefix=False)]
+        eng.run()
+        # the opted-out request neither hit nor populated, and stayed exact
+        assert eng.stats["prefix_hit_tokens"] == 0
+        for req, prompt in zip(reqs, warm[:2]):
+            assert req.tokens == _expected(model, params, prompt, gen)
+
+    def test_compiled_shape_budget_includes_copies(self):
+        """Hits replay through exactly one fixed copy executable per bucket —
+        the compiled-shape budget grows by len(buckets) and nothing else."""
+        if not jit_cache_supported():
+            pytest.skip("this jax hides the pjit executable-cache counter")
+        model, params = _tiny_model()
+        rng = np.random.default_rng(23)
+        vocab = model.config.vocab_size
+        p8 = rng.integers(1, vocab, (8,)).astype(np.int32)
+        p4 = rng.integers(1, vocab, (4,)).astype(np.int32)
+        eng = _engine(model, params)
+        gen = GenerationConfig(max_new_tokens=3)
+        # duplicates at each bucket length + varied offsets/partials around them
+        prompts = [p8, p8.copy(), p4, p4.copy(),
+                   np.concatenate([p8, p4]), np.concatenate([p8, p4, p4[:1]])]
+        reqs = eng.serve(prompts, [gen] * len(prompts))
+        for req, prompt in zip(reqs, prompts):
+            assert req.tokens == _expected(model, params, prompt, gen)
+        assert eng.compiled_executable_counts() == {
+            "decode_window": 1, "insert": 1, "prefill_4": 1, "prefill_8": 1,
+            "copy_4": 1, "copy_8": 1,
+        }
+        assert not any(wd.over_budget() for wd in eng._copy.values())
+
+    def test_eviction_under_tiny_engine_budget_stays_exact(self):
+        """A budget far below the workload's slab footprint churns the cache
+        hard (insert/evict on nearly every chunk) without touching outputs."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(24)
+        prompts = _prompts(rng, [8, 12, 9, 16, 8], model.config.vocab_size)
+        gens = [GenerationConfig(max_new_tokens=n) for n in (4, 6, 3, 5, 4)]
+        # one float32 8-chunk slab for the tiny model is ~4 KiB; 6 KiB holds
+        # barely one, so every new full chunk forces an eviction decision
+        eng = _engine(model, params, prefix_cache_mb=6 / 1024)
+        reqs = eng.serve(prompts, gens)
+        for req, prompt, gen in zip(reqs, prompts, gens):
+            assert req.tokens == _expected(model, params, prompt, gen)
+        assert eng.prefix_cache.evictions > 0
+        assert eng.prefix_cache.bytes <= eng.prefix_cache.capacity
+
+    def test_hit_metrics_flow_through_registry(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(25)
+        shared, warm, _ = self._shared_workload(model, rng)
+        reg = MetricsRegistry()
+        eng = _engine(model, params, registry=reg)
+        eng.serve(warm, GenerationConfig(max_new_tokens=3))
+        snap = reg.snapshot()
+        assert snap["serve/prefix_hit_tokens_total"] == eng.stats["prefix_hit_tokens"] > 0
+        assert snap["serve/prefix_miss_tokens_total"] == eng.stats["prefix_miss_tokens"]
+        assert 0.0 < snap["serve/prefix_hit_rate"] < 1.0
+        assert snap["serve/prefix_cache_bytes"] == eng.prefix_cache.bytes > 0
+        assert snap["serve/prefix_cache_nodes"] == eng.prefix_cache.num_nodes
+
+
+class TestCancel:
+    def test_cancel_queued_request(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(26)
+        prompts = _prompts(rng, [4, 5, 4], model.config.vocab_size)
+        gen = GenerationConfig(max_new_tokens=3)
+        eng = _engine(model, params, num_slots=1, decode_window=1)
+        reqs = [eng.submit(p, config=gen) for p in prompts]
+        assert eng.cancel(reqs[2])          # by handle, while still queued
+        eng.run()
+        assert reqs[2].state is RequestState.CANCELLED and reqs[2].tokens == []
+        for req, prompt in zip(reqs[:2], prompts[:2]):
+            assert req.done and req.tokens == _expected(model, params, prompt, gen)
+        assert eng.stats["cancelled"] == 1
+        assert eng.stats["requests_completed"] == 2
+
+    def test_cancel_running_done_or_unknown_is_false(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(27)
+        eng = _engine(model, params)
+        req = eng.submit(_prompts(rng, [4], model.config.vocab_size)[0],
+                         max_new_tokens=3)
+        eng.step()                          # admitted: past the point of no return
+        assert not eng.cancel(req.rid)
+        eng.run()
+        assert req.done and not eng.cancel(req)
+        assert not eng.cancel(999)
+        assert eng.stats["cancelled"] == 0
+
+    def test_cancel_releases_pinned_prefix_nodes(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(28)
+        shared = rng.integers(1, model.config.vocab_size, (8,)).astype(np.int32)
+        eng = _engine(model, params)
+        eng.serve([shared], GenerationConfig(max_new_tokens=2))   # populate
+        (node,) = eng.prefix_cache._nodes
+        assert node.refs == 0
+        req = eng.submit(np.concatenate([shared, shared[:3]]), max_new_tokens=2)
+        assert node.refs == 1               # pinned by the submit-time match
+        assert eng.cancel(req)
+        assert node.refs == 0

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Lint: no ``functools.lru_cache`` / ``functools.cache`` on instance methods.
+
+An lru_cache on a method keys its cache on ``self``: every instance gets its
+own entry, the cache keeps each instance alive for the lifetime of the class
+(a memory leak), and per-instance state silently defeats the dedupe the cache
+was meant to provide — exactly the bug class fixed in
+``MultiProcessAdapter.warning_once`` (the re-warning-per-adapter-instance
+leak; see ``accelerate_tpu/logging.py``).  Module-level functions are fine;
+methods must use an explicit container keyed on what they actually mean to
+dedupe (a module-level set/dict, or ``functools.cached_property`` for a
+compute-once attribute).
+
+Exempt:
+
+* ``accelerate_tpu/test_utils/`` and ``accelerate_tpu/commands/`` (matching
+  ``check_no_bare_print.py`` — short-lived CLI/test objects can't leak long);
+* ``@staticmethod`` methods (no ``self``/``cls`` in the key — an ordinary
+  cached function that happens to live in a class namespace);
+* lines carrying a ``# noqa: method-lru-cache`` pragma.
+
+Exit status 1 with one ``path:line`` diagnostic per violation; 0 when clean.
+Wired into ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "accelerate_tpu"
+EXEMPT_DIRS = ("test_utils", "commands")
+BANNED = ("lru_cache", "cache")
+PRAGMA = "noqa: method-lru-cache"
+
+
+def _deco_name(deco: ast.expr) -> str:
+    """Dotted name of a decorator, unwrapping a call: ``functools.lru_cache``,
+    ``lru_cache``, ``staticmethod`` ..."""
+    target = deco.func if isinstance(deco, ast.Call) else deco
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+        return f"{target.value.id}.{target.attr}"
+    return ""
+
+
+def _is_banned(deco: ast.expr) -> bool:
+    name = _deco_name(deco)
+    return name in BANNED or name in tuple(f"functools.{b}" for b in BANNED)
+
+
+def check_file(path: Path) -> list:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:  # quality target also runs compileall; be loud
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+    src_lines = source.splitlines()
+    violations = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            deco_names = [_deco_name(d) for d in fn.decorator_list]
+            if "staticmethod" in deco_names:
+                continue
+            args = fn.args.posonlyargs + fn.args.args
+            if not args or args[0].arg not in ("self", "cls"):
+                continue
+            for deco in fn.decorator_list:
+                if not _is_banned(deco):
+                    continue
+                if PRAGMA in src_lines[deco.lineno - 1]:
+                    continue
+                rel = path.relative_to(REPO_ROOT)
+                violations.append(
+                    f"{rel}:{deco.lineno}: functools.{_deco_name(deco).split('.')[-1]} "
+                    f"on method {cls.name}.{fn.name} — the cache keys on "
+                    f"{args[0].arg!r}, leaking every instance and deduping "
+                    "per-instance; use a module-level container or cached_property"
+                )
+    return violations
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel_parts = path.relative_to(PACKAGE).parts
+        if rel_parts[0] in EXEMPT_DIRS or path.name == "__main__.py":
+            continue
+        violations.extend(check_file(path))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_no_method_lru_cache: {len(violations)} violation(s)")
+        return 1
+    print("check_no_method_lru_cache: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
