@@ -48,3 +48,4 @@ quality:
 	python tools/check_reference_citations.py
 	python tools/check_no_bare_print.py
 	python tools/check_no_method_lru_cache.py
+	python tools/check_metric_docs.py

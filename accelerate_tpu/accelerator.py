@@ -62,6 +62,9 @@ from .state import AcceleratorState, GradientState, PartialState
 from .telemetry import get_registry as _get_telemetry_registry
 from .telemetry import get_tracer as _get_tracer
 from .telemetry import metrics as _telemetry_metrics
+from .telemetry.cost import CostTable, detect_device_peaks
+from .telemetry.flight_recorder import get_flight_recorder
+from .telemetry.server import start_debug_server
 from .telemetry.tracer import set_device_trace_active
 from .telemetry.watchdog import RecompileWatchdog
 from .train_state import DynamicLossScale, TrainState, global_norm, tree_finite
@@ -156,6 +159,7 @@ class Accelerator:
         kwargs_handlers: Optional[List[Any]] = None,
         compilation_config: Optional[CompilationConfig] = None,
         dynamo_backend: Optional[str] = None,  # accepted for API parity; XLA always compiles
+        metrics_port: Optional[int] = None,  # debug server port; 0 = ephemeral, None = env/off
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -284,12 +288,50 @@ class Accelerator:
         # every built-in surface records into.  See docs/usage/observability.md.
         self.telemetry = _get_telemetry_registry()
         self.tracer = _get_tracer()
+        # Flight recorder + XLA cost accounting + opt-in debug endpoint.
+        # The recorder's heartbeat comes from the instrumented train step;
+        # the cost table is filled lazily (analyze_costs / a /metrics scrape)
+        # so the hot path never waits on a second compile.
+        self.flight_recorder = get_flight_recorder()
+        self.cost_table = CostTable(self.telemetry)
+        self.device_peaks = detect_device_peaks()
+        self.debug_server = start_debug_server(
+            metrics_port, registry=self.telemetry, recorder=self.flight_recorder
+        )
+        if self.debug_server is not None:
+            self.debug_server.add_collector(self.analyze_costs)
 
     def _track_state(self, state: TrainState) -> TrainState:
         self._latest_state = state
         if getattr(state, "tx", None) is not None:
             self._latest_state_by_tx[id(state.tx)] = state
         return state
+
+    def analyze_costs(self) -> Dict[str, Any]:
+        """Run XLA ``cost_analysis``/``memory_analysis`` over every captured
+        executable (train/eval steps compiled by this accelerator) and
+        publish the ``train/model_flops`` / ``train/hbm_peak_bytes`` gauges.
+
+        Best-effort and idempotent; the first call re-lowers (and compiles)
+        each executable from its recorded abstract signature, so call it off
+        the step loop — benches do, and the debug server runs it as a scrape
+        collector.  ``train/step_mfu`` updates on the next instrumented step
+        once FLOPs are known.
+        """
+        snap = self.cost_table.analyze_all()
+        for name, entry in snap.items():
+            if name.startswith("train_step/"):
+                if entry.get("flops"):
+                    self.telemetry.gauge(
+                        "train/model_flops",
+                        help="XLA-estimated FLOPs per train step",
+                    ).set(entry["flops"])
+                if entry.get("hbm_peak_bytes"):
+                    self.telemetry.gauge(
+                        "train/hbm_peak_bytes",
+                        help="train step executable HBM peak (arg+out+temp-alias)",
+                    ).set(entry["hbm_peak_bytes"])
+        return snap
 
     # --------------------------------------------------------------- topology
     def _default_mesh(self):
@@ -1622,17 +1664,48 @@ class Accelerator:
         # in-loop, so async dispatch is preserved.
         registry = self.telemetry
         tracer = self.tracer
+        recorder = self.flight_recorder
+        cost_table = self.cost_table
+        peak_flops = self.device_peaks.flops_per_s
+        cost_key = f"train_step/{getattr(loss_fn, '__name__', 'loss')}"
         step_hist = registry.histogram("train/step_time_s", help="train step wall time (s)")
         steps_total = registry.counter("train/steps_total", help="train step calls")
         tokens_total = registry.counter("train/tokens_total", help="tokens (or samples) stepped")
         tps_gauge = registry.gauge("train/tokens_per_s", help="last-step token throughput")
         gnorm_gauge = registry.gauge("train/grad_norm", help="last-step gradient norm (deferred)")
         loss_gauge = registry.gauge("train/loss", help="last-step loss (deferred)")
+        mfu_gauge = registry.gauge(
+            "train/step_mfu", help="measured FLOPs/s over chip peak, clamped to (0, 1]"
+        )
+        flops_gauge = registry.gauge(
+            "train/model_flops", help="XLA-estimated FLOPs per train step"
+        )
+        hbm_gauge = registry.gauge(
+            "train/hbm_peak_bytes", help="train step executable HBM peak (arg+out+temp-alias)"
+        )
 
         @functools.wraps(step)
         def instrumented(state, batch):
             if not _telemetry_metrics.enabled():
                 return step(state, batch)
+            if not cost_table.captured(cost_key):
+                # First call: record only the abstract signature (no buffers)
+                # so analyze_costs() can re-lower off the hot path. The
+                # sync-flag value is shape-irrelevant. Python-dispatch paths
+                # (accumulation splitter, chunked offload) yield graceful
+                # None downstream — jitted has no .lower there.
+                cost_table.capture(cost_key, jitted, (state, batch, False))
+                try:
+                    shapes = sorted(
+                        {
+                            str(tuple(leaf.shape))
+                            for leaf in jax.tree_util.tree_leaves(batch)
+                            if hasattr(leaf, "shape")
+                        }
+                    )
+                except Exception:
+                    shapes = None
+                recorder.record("train/capture", name=cost_key, batch_shapes=shapes)
             t0 = time.perf_counter()
             with tracer.span("train/step"):
                 new_state, metrics = step(state, batch)
@@ -1643,11 +1716,28 @@ class Accelerator:
             if ntok:
                 tokens_total.inc(ntok)
                 tps_gauge.set(ntok / dt if dt > 0 else 0.0)
+            loss = None
             if isinstance(metrics, dict):
                 if metrics.get("grad_norm") is not None:
                     gnorm_gauge.set(metrics["grad_norm"])
                 if metrics.get("loss") is not None:
-                    loss_gauge.set(metrics["loss"])
+                    loss = metrics["loss"]
+                    loss_gauge.set(loss)
+            # Cost-derived gauges: dict lookups only; None until someone ran
+            # analyze_costs() (bench, scrape collector, flight dump).
+            flops = cost_table.flops(cost_key)
+            if flops:
+                flops_gauge.set(flops)
+                if dt > 0:
+                    mfu_gauge.set(min(1.0, flops / dt / peak_flops))
+            hbm = cost_table.hbm_peak_bytes(cost_key)
+            if hbm:
+                hbm_gauge.set(hbm)
+            # Progress heartbeat: feeds the stall detector and /healthz; the
+            # loss stays a live device value until a dump coerces it.
+            recorder.heartbeat(
+                "train/step", step=steps_total.value, dt_s=dt, tokens=ntok, loss=loss
+            )
             return new_state, metrics
 
         instrumented._jitted = jitted
@@ -1764,12 +1854,16 @@ class Accelerator:
         )
         registry = self.telemetry
         tracer = self.tracer
+        cost_table = self.cost_table
+        cost_key = f"eval_step/{getattr(eval_fn, '__name__', 'eval')}"
         eval_hist = registry.histogram("eval/step_time_s", help="eval step wall time (s)")
 
         @functools.wraps(eval_fn)
         def instrumented(state_or_params, batch):
             if not _telemetry_metrics.enabled():
                 return jitted(state_or_params, batch)
+            if not cost_table.captured(cost_key):
+                cost_table.capture(cost_key, jitted, (state_or_params, batch))
             t0 = time.perf_counter()
             with tracer.span("eval/step"):
                 out = jitted(state_or_params, batch)

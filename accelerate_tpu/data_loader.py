@@ -28,6 +28,7 @@ import numpy as np
 
 from .parallel import mesh as mesh_lib
 from .state import GradientState, PartialState
+from .telemetry import get_flight_recorder as _get_flight_recorder
 from .telemetry import get_registry as _get_telemetry_registry
 from .utils.dataclasses import DataLoaderConfiguration, RNGType
 from .utils.operations import (
@@ -463,6 +464,12 @@ class DataLoaderShard(DataLoaderStateMixin):
         registry.histogram(
             "data/device_put_s", help="device placement dispatch wall time"
         ).observe(t2 - t1)
+        # Ring event (NOT a heartbeat — the prefetch thread may still be
+        # fetching while the step itself is stuck; only steps mark progress):
+        # in a hang dump this shows whether data was still flowing.
+        _get_flight_recorder().record(
+            "data/fetch", fetch_s=t1 - t0, device_put_s=t2 - t1
+        )
         return placed
 
     def __iter__(self):

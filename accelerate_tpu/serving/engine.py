@@ -38,7 +38,16 @@ import numpy as np
 from ..logging import get_logger
 from ..models.generation import GenerationConfig
 from ..models.transformer import KVCache, Transformer
-from ..telemetry import MetricsRegistry, RecompileWatchdog, get_registry, get_tracer
+from ..telemetry import (
+    CostTable,
+    MetricsRegistry,
+    RecompileWatchdog,
+    detect_device_peaks,
+    get_flight_recorder,
+    get_registry,
+    get_tracer,
+    start_debug_server,
+)
 from .pool import (
     jit_cache_sizes,
     make_copy_chunk,
@@ -81,6 +90,10 @@ class ServingEngine:
     prefix_cache_mb: byte budget (MiB) for the chunk-granular prefix KV cache
         (:mod:`.prefix_cache`); ``0``/``None`` disables it.  Requests opt out
         per-request via ``submit(..., cache_prefix=False)``.
+    metrics_port: start (or join) the process-wide debug server
+        (``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``)
+        on this port; ``0`` binds an ephemeral port, ``None`` defers to
+        ``ATPU_METRICS_PORT`` (off when unset).
     """
 
     def __init__(
@@ -98,6 +111,7 @@ class ServingEngine:
         slot_order: Optional[Sequence[int]] = None,
         registry: Optional[MetricsRegistry] = None,
         prefix_cache_mb: Optional[float] = 64.0,
+        metrics_port: Optional[int] = None,
     ):
         cfg = model.config
         self.model = model
@@ -137,6 +151,18 @@ class ServingEngine:
         self.scratch = KVCache.create(cfg, 1, self.max_prompt_len)
         self.metrics = registry if registry is not None else get_registry()
         self.tracer = get_tracer()
+        # Forensics + cost accounting (docs/usage/observability.md): request
+        # lifecycle events land in the process flight recorder, per-executable
+        # FLOP/HBM signatures in a private cost table (filled lazily by
+        # analyze_costs / a /metrics scrape — never in the serve loop).
+        self.recorder = get_flight_recorder()
+        self.cost_table = CostTable(self.metrics)
+        self.device_peaks = detect_device_peaks()
+        self.debug_server = start_debug_server(
+            metrics_port, registry=self.metrics, recorder=self.recorder
+        )
+        if self.debug_server is not None:
+            self.debug_server.add_collector(self.analyze_costs)
         # budget=1 per executable: the engine's whole design promises exactly
         # one compiled shape each — any second signature is a bug worth a warning
         self._decode = RecompileWatchdog(
@@ -172,6 +198,7 @@ class ServingEngine:
             self.buckets,
             prefill_token_budget if prefill_token_budget is not None else self.buckets[-1],
             prefix_cache=self.prefix_cache,
+            recorder=self.recorder,
         )
 
         n = self.num_slots
@@ -226,6 +253,14 @@ class ServingEngine:
         self._hit_rate_gauge = self.metrics.gauge(
             "serve/prefix_hit_rate",
             help="prefix_hit_tokens / (hit + miss) over cache-eligible prefill",
+        )
+        self._decode_flops_gauge = self.metrics.gauge(
+            "serve/decode_flops_per_token",
+            help="decode-window XLA FLOPs / (window * num_slots)",
+        )
+        self._hbm_gauge = self.metrics.gauge(
+            "serve/hbm_peak_bytes",
+            help="largest per-executable HBM peak across the serving pool",
         )
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -313,12 +348,20 @@ class ServingEngine:
                 # replay the retained slab: one dynamic_update_slice at the
                 # scratch index, zero budget charged (no forward pass ran)
                 node = req.cache_nodes[req.next_chunk - 1]
+                self.cost_table.capture(
+                    f"serve/copy_{bucket}", self._copy[bucket],
+                    (self.scratch, node.k, node.v),
+                )
                 with self.tracer.span("serve/copy_chunk", bucket=bucket, start=start):
                     self.scratch = self._copy[bucket](self.scratch, node.k, node.v)
                 self._bump("prefix_hit_tokens", valid)
             else:
                 chunk = np.zeros(bucket, np.int32)
                 chunk[:valid] = req.prompt[start:start + valid]
+                self.cost_table.capture(
+                    f"serve/prefill_{bucket}", self._prefill[bucket],
+                    (self.params, chunk[None], self.scratch),
+                )
                 with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
                     self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
                 budget -= bucket
@@ -360,9 +403,17 @@ class ServingEngine:
         ``dynamic_update_slice`` into the pool + host lane-state updates."""
         s = req.slot
         plen = len(req.prompt)
+        self.cost_table.capture(
+            "serve/insert", self._insert,
+            (self.pool, self.scratch.k, self.scratch.v, jnp.int32(s), jnp.int32(plen - 1)),
+        )
         self.pool = self._insert(
             self.pool, self.scratch.k, self.scratch.v,
             jnp.int32(s), jnp.int32(plen - 1),
+        )
+        self.recorder.record(
+            "serve/install", rid=req.rid, slot=s, step=self._step_count,
+            prompt_len=plen,
         )
         gen = req.config
         self._pending_tok[s] = req.prompt[-1]
@@ -392,12 +443,29 @@ class ServingEngine:
         req.state = RequestState.DONE
         req.finish_step = self._step_count
         self._bump("requests_completed")
+        self.recorder.record(
+            "serve/finish", rid=req.rid, slot=slot, step=self._step_count,
+            tokens=len(req.tokens), steps=self._step_count - req.submit_step,
+        )
 
     def _decode_window(self) -> None:
         if not self._active.any():
             return
         n_occupied = int(self._active.sum())
         self._occupancy_gauge.set(n_occupied / self.num_slots)
+        if not self.cost_table.captured("serve/decode_window"):
+            self.cost_table.capture(
+                "serve/decode_window", self._decode,
+                (
+                    self.params, self.pool,
+                    jnp.asarray(self._pending_tok), jnp.asarray(self._active),
+                    jnp.asarray(self._eos), jnp.asarray(self._do_sample),
+                    jnp.asarray(self._temperature), jnp.asarray(self._top_k),
+                    jnp.asarray(self._top_p),
+                    jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
+                    jnp.asarray(self._rngs),
+                ),
+            )
         with self.tracer.span("serve/decode_window", occupied=n_occupied):
             self.pool, toks, rngs = self._decode(
                 self.params, self.pool,
@@ -444,9 +512,8 @@ class ServingEngine:
     def step(self) -> None:
         """One engine iteration: budgeted chunked-prefill admission, then one
         masked decode window over the pool."""
-        self._queue_gauge.set(
-            len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
-        )
+        queue_depth = len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
+        self._queue_gauge.set(queue_depth)
         self._admit()
         if self.prefix_cache is not None:
             covered = self.stats["prefix_hit_tokens"] + self.stats["prefix_miss_tokens"]
@@ -454,6 +521,12 @@ class ServingEngine:
                 self._hit_rate_gauge.set(self.stats["prefix_hit_tokens"] / covered)
         self._decode_window()
         self._step_count += 1
+        # Progress heartbeat for the stall detector / /healthz; also the
+        # ring's per-step record of what the pool looked like.
+        self.recorder.heartbeat(
+            "serve/step", step=self._step_count, queue=queue_depth,
+            occupied=int(self._active.sum()),
+        )
 
     @property
     def has_work(self) -> bool:
@@ -533,6 +606,26 @@ class ServingEngine:
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.stats())
         return out
+
+    def analyze_costs(self) -> dict:
+        """XLA cost/memory analysis over every executable the pool has run
+        (decode window, hit prefill/copy buckets, insert) and publish the
+        ``serve/decode_flops_per_token`` / ``serve/hbm_peak_bytes`` gauges.
+
+        Best-effort and idempotent — re-lowers from recorded abstract
+        signatures, so call it off the serve loop (benches do; the debug
+        server runs it as a scrape collector).  Returns the cost-table
+        snapshot."""
+        snap = self.cost_table.analyze_all()
+        decode_flops = self.cost_table.flops("serve/decode_window")
+        if decode_flops:
+            self._decode_flops_gauge.set(
+                decode_flops / (self.window * self.num_slots)
+            )
+        hbm = self.cost_table.max_hbm_peak_bytes()
+        if hbm:
+            self._hbm_gauge.set(hbm)
+        return snap
 
     def compiled_executable_counts(self) -> dict:
         """Per-executable jit-cache sizes — the no-retrace contract: after any

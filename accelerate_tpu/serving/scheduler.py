@@ -27,6 +27,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..models.generation import GenerationConfig
+from ..telemetry import get_flight_recorder
 from .pool import plan_chunks
 
 
@@ -105,7 +106,7 @@ class Scheduler:
     """
 
     def __init__(self, prefill_buckets: Sequence[int], prefill_token_budget: int,
-                 prefix_cache=None):
+                 prefix_cache=None, recorder=None):
         self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
         if not self.buckets:
             raise ValueError("need at least one prefill bucket")
@@ -118,6 +119,9 @@ class Scheduler:
         self.queue: deque = deque()
         self.prefilling: Optional[Request] = None
         self.prefix_cache = prefix_cache
+        # request-lifecycle events for post-mortems (a no-op ring append when
+        # telemetry is disabled); the engine passes the process recorder
+        self.recorder = recorder if recorder is not None else get_flight_recorder()
 
     def _match_prefix(self, request: Request) -> None:
         """(Re)walk the radix tree for ``request``'s longest cached prefix and
@@ -137,6 +141,11 @@ class Scheduler:
         request.chunks = plan_chunks(len(request.prompt), self.buckets)
         self._match_prefix(request)
         self.queue.append(request)
+        self.recorder.record(
+            "serve/submit", rid=request.rid, prompt_len=len(request.prompt),
+            chunks=len(request.chunks), cached_chunks=request.cached_chunks,
+            queue_depth=len(self.queue),
+        )
 
     def cancel(self, rid: int) -> Optional[Request]:
         """Drop a still-QUEUED request (not yet prefilling) from the queue.
@@ -154,6 +163,7 @@ class Scheduler:
                     self.prefix_cache.release(req.cache_nodes)
                     req.cache_nodes = []
                 req.state = RequestState.CANCELLED
+                self.recorder.record("serve/cancel", rid=rid)
                 return req
         return None
 
@@ -176,6 +186,10 @@ class Scheduler:
         # populated exactly the chunks this one needs (the batch-submit case)
         self._match_prefix(req)
         self.prefilling = req
+        self.recorder.record(
+            "serve/prefill_start", rid=req.rid, slot=slot,
+            chunks=len(req.chunks), cached_chunks=req.cached_chunks,
+        )
         return req
 
     def take_chunk(self, budget: int) -> Optional[Tuple[Request, int, int, int, bool]]:

@@ -1,4 +1,4 @@
-"""Unified telemetry: metrics registry, span tracing, recompile watchdog.
+"""Unified telemetry: metrics, spans, watchdog, cost table, flight recorder.
 
 Zero-dependency observability for the train and serve hot paths (see
 ``docs/usage/observability.md``):
@@ -12,12 +12,35 @@ Zero-dependency observability for the train and serve hot paths (see
 * :mod:`.watchdog` — per-callable ``(shape, dtype)`` signature accounting
   with compile budgets: a silent retrace becomes a logged warning and a
   gauge, not a mystery slowdown.
+* :mod:`.cost` — XLA ``cost_analysis``/``memory_analysis`` accounting per
+  owned executable; the substrate for ``train/step_mfu`` and
+  ``*/hbm_peak_bytes`` gauges.
+* :mod:`.flight_recorder` — bounded ring of lifecycle events, a stall
+  detector that dumps all-thread stacks when progress heartbeats stop, and
+  crash hooks writing JSON artifacts to ``ATPU_FLIGHT_DIR``.
+* :mod:`.server` — opt-in stdlib HTTP daemon (``ATPU_METRICS_PORT``)
+  serving ``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``.
 
 Everything is on by default and costs nanoseconds per observation;
 ``ATPU_TELEMETRY=0`` (or :func:`set_enabled` / ``get_tracer().enabled``)
-turns the hot-path hooks into single boolean checks.
+turns the hot-path hooks into single boolean checks and disables the
+recorder, detector, and debug server outright.
 """
 
+from .cost import (
+    CPU_FALLBACK_PEAKS,
+    CostTable,
+    DevicePeaks,
+    HARDWARE_PEAKS,
+    detect_device_peaks,
+)
+from .flight_recorder import (
+    FlightRecorder,
+    StallDetector,
+    all_thread_stacks,
+    get_flight_recorder,
+    install_crash_hooks,
+)
 from .metrics import (
     Counter,
     DEFAULT_TIME_BUCKETS,
@@ -28,6 +51,13 @@ from .metrics import (
     exponential_buckets,
     get_registry,
     set_enabled,
+)
+from .server import (
+    DebugServer,
+    get_debug_server,
+    resolve_metrics_port,
+    start_debug_server,
+    stop_debug_server,
 )
 from .tracer import (
     Tracer,
@@ -58,4 +88,19 @@ __all__ = [
     "RecompileWatchdog",
     "watch_recompiles",
     "arg_signature",
+    "CostTable",
+    "DevicePeaks",
+    "HARDWARE_PEAKS",
+    "CPU_FALLBACK_PEAKS",
+    "detect_device_peaks",
+    "FlightRecorder",
+    "StallDetector",
+    "get_flight_recorder",
+    "install_crash_hooks",
+    "all_thread_stacks",
+    "DebugServer",
+    "start_debug_server",
+    "get_debug_server",
+    "stop_debug_server",
+    "resolve_metrics_port",
 ]

@@ -170,6 +170,16 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
+    # The internal extrema start at +/-inf so `observe` is branch-light; the
+    # public accessors clamp the empty case to 0 (inf poisons JSON exports).
+    @property
+    def min(self) -> float:
+        return self._min if self._count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else 0.0
+
     def percentile(self, q: float) -> float:
         """Interpolated ``q``-th percentile (``q`` in [0, 100])."""
         if not 0 <= q <= 100:
@@ -221,11 +231,21 @@ def _prom_name(name: str, namespace: str) -> str:
 
 
 def _fmt(v: float) -> str:
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
     if v == math.inf:
         return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
-    return repr(float(v))
+    return repr(v)
+
+
+def _escape_help(text: str) -> str:
+    """Prometheus exposition: HELP text escapes backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class MetricsRegistry:
@@ -317,17 +337,17 @@ class MetricsRegistry:
             pname = _prom_name(name, ns)
             if isinstance(metric, Counter):
                 if metric.help:
-                    lines.append(f"# HELP {pname}_total {metric.help}")
+                    lines.append(f"# HELP {pname}_total {_escape_help(metric.help)}")
                 lines.append(f"# TYPE {pname}_total counter")
                 lines.append(f"{pname}_total {_fmt(metric.value)}")
             elif isinstance(metric, Gauge):
                 if metric.help:
-                    lines.append(f"# HELP {pname} {metric.help}")
+                    lines.append(f"# HELP {pname} {_escape_help(metric.help)}")
                 lines.append(f"# TYPE {pname} gauge")
                 lines.append(f"{pname} {_fmt(metric.value)}")
             elif isinstance(metric, Histogram):
                 if metric.help:
-                    lines.append(f"# HELP {pname} {metric.help}")
+                    lines.append(f"# HELP {pname} {_escape_help(metric.help)}")
                 lines.append(f"# TYPE {pname} histogram")
                 cum = 0
                 for bound, c in zip(metric._bounds, metric._counts):

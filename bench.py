@@ -102,6 +102,11 @@ def bench_lm_proxy():
     # D2H materialization is the portable completion barrier.
     float(metrics["loss"])
 
+    # Fill the XLA cost table off the clock (re-lowers the captured step
+    # signature): the per-step train/step_mfu gauge update inside the timed
+    # loop is then a dict lookup + gauge store, nothing more.
+    cost_snap = acc.analyze_costs()
+
     t0 = time.perf_counter()
     for _ in range(STEPS):
         state, metrics = step(state, batch)
@@ -148,7 +153,26 @@ def bench_lm_proxy():
     }
     if peak is not None:
         detail["chip_peak_tflops"] = peak
-        detail["mfu"] = round(tflops / n_chips / peak, 4)
+
+    # MFU: prefer XLA's own cost model for the numerator (the compiled step's
+    # actual FLOPs — fusion, remat recompute and all); the 6*N*S analytic
+    # estimate is the fallback when the backend has no cost_analysis.  The
+    # denominator always resolves (detect_device_peaks has a generic-CPU
+    # fallback), so detail.mfu is present — finite and in (0, 1] — on every
+    # platform, with mfu_source labeling how honest the number is.
+    cost_entry = next(
+        (v for k, v in cost_snap.items() if k.startswith("train_step/")), None
+    )
+    xla_flops = cost_entry.get("flops") if cost_entry else None
+    peak_flops_per_s = acc.device_peaks.flops_per_s * n_chips
+    if xla_flops:
+        detail["mfu"] = round(min(1.0, xla_flops * STEPS / dt / peak_flops_per_s), 6)
+        detail["mfu_source"] = "xla_cost_analysis"
+    else:
+        detail["mfu"] = round(min(1.0, tflops * 1e12 / peak_flops_per_s), 6)
+        detail["mfu_source"] = "analytic_6NS"
+    if cost_entry and cost_entry.get("hbm_peak_bytes"):
+        detail["hbm_peak_bytes"] = cost_entry["hbm_peak_bytes"]
 
     # Per-phase breakdown from the unified telemetry layer (ISSUE: the bench
     # JSON carries the span rollup + step-time percentiles + compile counts).
@@ -277,6 +301,22 @@ def _bench_train_config(
     if peak is not None:
         detail["chip_peak_tflops"] = peak
         detail["mfu"] = round(tflops / n_chips / peak, 4)
+    # XLA cost/HBM accounting (best-effort: the zero3/accumulation paths
+    # dispatch through python wrappers XLA cannot analyze — graceful absence)
+    cost_entry = next(
+        (v for k, v in acc.analyze_costs().items() if k.startswith("train_step/")),
+        None,
+    )
+    if cost_entry:
+        if cost_entry.get("hbm_peak_bytes"):
+            detail["hbm_peak_bytes"] = cost_entry["hbm_peak_bytes"]
+        if cost_entry.get("flops"):
+            detail["mfu"] = round(
+                min(1.0, cost_entry["flops"] * steps / dt
+                    / (acc.device_peaks.flops_per_s * n_chips)),
+                6,
+            )
+            detail["mfu_source"] = "xla_cost_analysis"
     print(
         json.dumps(
             {
@@ -507,6 +547,20 @@ def bench_longseq(
     if peak:
         detail["chip_peak_tflops"] = peak
         detail["mfu"] = round(tflops / n_chips / peak, 4)
+    cost_entry = next(
+        (v for k, v in acc.analyze_costs().items() if k.startswith("train_step/")),
+        None,
+    )
+    if cost_entry:
+        if cost_entry.get("hbm_peak_bytes"):
+            detail["hbm_peak_bytes"] = cost_entry["hbm_peak_bytes"]
+        if cost_entry.get("flops"):
+            detail["mfu"] = round(
+                min(1.0, cost_entry["flops"] * steps / dt
+                    / (acc.device_peaks.flops_per_s * n_chips)),
+                6,
+            )
+            detail["mfu_source"] = "xla_cost_analysis"
     print(
         json.dumps(
             {
@@ -585,7 +639,25 @@ def bench_cv(smoke: bool = False, batch: int = 128):
     peak = detect_peak_tflops()
     if peak:
         detail["chip_peak_tflops"] = peak
-        detail["mfu"] = round(per_chip * flops_per_image / 1e12 / peak, 4)
+    # MFU with the honest-FLOPs convention (models/resnet.py): the analytic
+    # conv+GEMM count is the *fallback* numerator; XLA's cost model — which
+    # sees the fused program the chip actually runs — takes precedence.
+    cost_entry = next(
+        (v for k, v in acc.analyze_costs().items() if k.startswith("train_step/")),
+        None,
+    )
+    peak_flops_per_s = acc.device_peaks.flops_per_s * n_chips
+    xla_flops = cost_entry.get("flops") if cost_entry else None
+    if xla_flops:
+        detail["mfu"] = round(min(1.0, xla_flops * steps / dt / peak_flops_per_s), 6)
+        detail["mfu_source"] = "xla_cost_analysis"
+    else:
+        detail["mfu"] = round(
+            min(1.0, per_chip * n_chips * flops_per_image / peak_flops_per_s), 6
+        )
+        detail["mfu_source"] = "analytic_resnet_flops"
+    if cost_entry and cost_entry.get("hbm_peak_bytes"):
+        detail["hbm_peak_bytes"] = cost_entry["hbm_peak_bytes"]
     print(
         json.dumps(
             {

@@ -74,6 +74,30 @@ def _presets():
     }
 
 
+def _cost_detail(eng, dt_engine):
+    """XLA cost-table numbers for the serve JSON contract: ``mfu`` and
+    ``hbm_peak_bytes``.  Decode MFU = window invocations x decode-window FLOPs
+    over wall time against the chip peak — prefill FLOPs are excluded, so this
+    understates true utilization (it is the steady-state decode number).
+    Empty when XLA cost analysis is unavailable on this backend."""
+    eng.analyze_costs()
+    out = {}
+    decode_flops = eng.cost_table.flops("serve/decode_window")
+    if decode_flops:
+        windows = eng.stats["decode_steps"] / eng.window
+        out["mfu"] = round(
+            min(1.0, windows * decode_flops / dt_engine / eng.device_peaks.flops_per_s), 6
+        )
+        out["mfu_source"] = "xla_cost_analysis"
+        out["decode_flops_per_token"] = round(
+            decode_flops / (eng.window * eng.num_slots), 1
+        )
+    hbm = eng.cost_table.max_hbm_peak_bytes()
+    if hbm:
+        out["hbm_peak_bytes"] = int(hbm)
+    return out
+
+
 def _shared_prefix_result(args, preset, shared, prompt_lens, out_lens,
                           useful_tokens, run_engine, eng, reqs, dt_on,
                           registry, samples, buckets, slots, window):
@@ -124,6 +148,7 @@ def _shared_prefix_result(args, preset, shared, prompt_lens, out_lens,
         "mean_slot_occupancy": round(eng.mean_slot_occupancy(), 3),
         "compiled_executables": eng.compiled_executable_counts(),
     }
+    detail.update(_cost_detail(eng, dt_on))
     return {
         "metric": "serving_prefix_cache_tokens_per_sec",
         "value": round(tps_on, 2),
@@ -292,6 +317,7 @@ def _serve_bench(args, model, cfg, params, preset):
         "mean_slot_occupancy": round(eng.mean_slot_occupancy(), 3),
         "compiled_executables": eng.compiled_executable_counts(),
     }
+    detail.update(_cost_detail(eng, dt_engine))
     # Engine-side telemetry (ISSUE: TTFT + per-token percentiles and compile
     # counts in the bench contract).  TTFT here includes queue wait — it is
     # submit-to-first-token as a caller observes it, not prefill time alone.
@@ -425,6 +451,12 @@ def main():
         print(json.dumps(result))
         return
 
+    # parameter count BEFORE quantization (int4 packing halves the element
+    # count, which would skew the analytic-FLOPs MFU below)
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+    )
+
     stream_cfg = cfg
     if args.bits is not None:
         from accelerate_tpu import Int4Config, Int8Config, quantize_model_params
@@ -485,12 +517,21 @@ def main():
         s_per_token = dt / args.new_tokens
         tokens_per_s = args.batch * args.new_tokens / dt
         stream_gbps = model_bytes * args.new_tokens / dt / 1e9
+        # Streaming dispatches per-stage executables, so there is no single
+        # lowered callable to ask XLA about — analytic 2N FLOPs/token.  For
+        # offload decode MFU is dominated by the host link, not the MXU.
+        from accelerate_tpu.telemetry import detect_device_peaks
+
+        peaks = detect_device_peaks()
+        mfu = 2.0 * n_params * args.batch * args.new_tokens / dt / peaks.flops_per_s
         detail.update(
             {
                 "s_per_token": round(s_per_token, 4),
                 "new_tokens": args.new_tokens,
                 "prefill_and_warmup_s": round(prefill_s, 2),
                 "effective_stream_gbps": round(stream_gbps, 2),
+                "mfu": round(min(1.0, mfu), 6),
+                "mfu_source": "analytic_2N",
             }
         )
         result = {
@@ -509,11 +550,16 @@ def main():
 
         tokens = args.batch * seq * args.iters
         stream_gbps = model_bytes * args.iters / dt / 1e9
+        from accelerate_tpu.telemetry import detect_device_peaks
+
+        peaks = detect_device_peaks()
         detail.update(
             {
                 "iters": args.iters,
                 "effective_stream_gbps": round(stream_gbps, 2),
                 "forward_ms": round(1e3 * dt / args.iters, 1),
+                "mfu": round(min(1.0, 2.0 * n_params * tokens / dt / peaks.flops_per_s), 6),
+                "mfu_source": "analytic_2N",
             }
         )
         result = {

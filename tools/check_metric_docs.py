@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Lint: every metric name registered in library code is documented.
+
+Any literal metric name passed to ``registry.counter(...)``,
+``registry.gauge(...)`` or ``registry.histogram(...)`` inside
+``accelerate_tpu/`` must appear verbatim in ``docs/usage/observability.md``
+— the doc is the operator-facing contract for what a ``/metrics`` scrape or
+a JSONL metrics file can contain, and an undocumented gauge is invisible to
+whoever has to build the dashboard.
+
+Only string-literal first arguments are checked; names built with f-strings
+or variables (e.g. the per-executable ``cost/<name>/...`` gauges) are
+dynamic families, documented as patterns, and skipped here.  Calls carrying
+a ``# noqa: metric-docs`` pragma on their line are exempt.
+
+Exit status 1 with one ``path:line: name`` diagnostic per violation; 0 when
+clean.  Wired into ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "accelerate_tpu"
+DOC = REPO_ROOT / "docs" / "usage" / "observability.md"
+FACTORIES = ("counter", "gauge", "histogram")
+PRAGMA = "noqa: metric-docs"
+
+
+def metric_literals(path: Path) -> list:
+    """``(lineno, kind, name)`` for every literal-name metric registration."""
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:  # quality target also runs compileall; be loud
+        print(f"{path}:{exc.lineno}: syntax error: {exc.msg}")
+        sys.exit(1)
+    src_lines = source.splitlines()
+    found = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in FACTORIES
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and PRAGMA not in src_lines[node.lineno - 1]
+        ):
+            found.append((node.lineno, node.func.attr, node.args[0].value))
+    return found
+
+
+def main() -> int:
+    if not DOC.exists():
+        print(f"check_metric_docs: missing {DOC.relative_to(REPO_ROOT)}")
+        return 1
+    doc_text = DOC.read_text()
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        for lineno, kind, name in metric_literals(path):
+            if name not in doc_text:
+                rel = path.relative_to(REPO_ROOT)
+                violations.append(
+                    f"{rel}:{lineno}: {kind} '{name}' is not documented in "
+                    f"{DOC.relative_to(REPO_ROOT)}"
+                )
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_metric_docs: {len(violations)} violation(s)")
+        return 1
+    print("check_metric_docs: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
