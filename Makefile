@@ -1,7 +1,7 @@
 # Developer targets (reference Makefile:25-72 test split analog).
 
 .PHONY: test test_fast test_slow test_core test_big_modeling test_cli test_examples \
-        test_multiprocess test_kernels native bench quality
+        test_multiprocess test_kernels native bench bench-serve quality
 
 test:
 	python -m pytest tests/ -q
@@ -42,6 +42,14 @@ native:
 bench:
 	python bench.py
 	python bench_inference.py
+
+# serving-engine A/Bs: continuous batching vs static generate, prefix-cache
+# on/off, and speculative decoding on/off (the spec run hard-fails unless
+# greedy outputs are token-identical between the two arms)
+bench-serve:
+	python bench_inference.py --task serve
+	python bench_inference.py --task serve --shared-prefix 16
+	python bench_inference.py --task spec
 
 quality:
 	python -m compileall -q accelerate_tpu

@@ -86,6 +86,41 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def filter_logits_batched(
+    logits: jax.Array,
+    *,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-lane sampling filters: ``[N, V]`` raw logits + knob *vectors* ->
+    filtered fp32 logits (suppressed entries at ``-inf``), temperature then
+    top-k then top-p — the same pipeline order as :func:`sample_tokens`.
+
+    Factored out of :func:`sample_tokens_batched` so the serving engine's
+    speculative verify window (:func:`~accelerate_tpu.serving.pool.make_verify_window`)
+    can apply the Leviathan accept/resample rule against exactly the
+    distribution ordinary decode would have sampled from.  ``top_k <= 0`` and
+    ``top_p >= 1`` disable their filters per lane.
+    """
+    v = logits.shape[-1]
+    neg_inf = jnp.finfo(jnp.float32).min
+    lf = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k: kth-largest per lane via one sort; lanes with top_k <= 0 keep all
+    sorted_desc = jnp.sort(lf, axis=-1)[:, ::-1]
+    kidx = jnp.clip(top_k, 1, v) - 1
+    kth = jnp.take_along_axis(sorted_desc, kidx[:, None], axis=-1)
+    lf = jnp.where((top_k > 0)[:, None] & (lf < kth), neg_inf, lf)
+    # top-p on the (possibly top-k-filtered) logits — same filter order as
+    # sample_tokens; second sort because the k-filter changed the tail
+    sorted_p = jnp.sort(lf, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_p, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    outside = (cum - probs) >= top_p[:, None]
+    min_kept = jnp.min(jnp.where(outside, jnp.inf, sorted_p), axis=-1, keepdims=True)
+    return jnp.where((top_p < 1.0)[:, None] & (lf < min_kept), neg_inf, lf)
+
+
 def sample_tokens_batched(
     logits: jax.Array,
     rngs: jax.Array,
@@ -106,26 +141,13 @@ def sample_tokens_batched(
     same decision :func:`sample_tokens` makes, which is what keeps the
     continuous-batching path token-exact vs ``generate`` for greedy requests.
     """
-    v = logits.shape[-1]
-    neg_inf = jnp.finfo(jnp.float32).min
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     use_sample = do_sample & (temperature > 0.0)
 
     def _sampled(_):
-        lf = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
-        # top-k: kth-largest per lane via one sort; lanes with top_k <= 0 keep all
-        sorted_desc = jnp.sort(lf, axis=-1)[:, ::-1]
-        kidx = jnp.clip(top_k, 1, v) - 1
-        kth = jnp.take_along_axis(sorted_desc, kidx[:, None], axis=-1)
-        lf = jnp.where((top_k > 0)[:, None] & (lf < kth), neg_inf, lf)
-        # top-p on the (possibly top-k-filtered) logits — same filter order as
-        # sample_tokens; second sort because the k-filter changed the tail
-        sorted_p = jnp.sort(lf, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_p, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        outside = (cum - probs) >= top_p[:, None]
-        min_kept = jnp.min(jnp.where(outside, jnp.inf, sorted_p), axis=-1, keepdims=True)
-        lf = jnp.where((top_p < 1.0)[:, None] & (lf < min_kept), neg_inf, lf)
+        lf = filter_logits_batched(
+            logits, temperature=temperature, top_k=top_k, top_p=top_p
+        )
         sampled = jax.vmap(lambda r, row: jax.random.categorical(r, row))(rngs, lf)
         return jnp.where(use_sample, sampled.astype(jnp.int32), greedy)
 

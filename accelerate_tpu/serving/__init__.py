@@ -10,10 +10,12 @@ from .pool import (
     make_decode_window,
     make_insert,
     make_prefill_chunk,
+    make_verify_window,
     plan_chunks,
 )
 from .prefix_cache import PrefixCache, PrefixNode, rolling_hash
 from .scheduler import Request, RequestState, Scheduler
+from .spec import propose_ngram_draft
 
 __all__ = [
     "ServingEngine",
@@ -25,8 +27,10 @@ __all__ = [
     "rolling_hash",
     "plan_chunks",
     "make_decode_window",
+    "make_verify_window",
     "make_prefill_chunk",
     "make_insert",
     "make_copy_chunk",
+    "propose_ngram_draft",
     "jit_cache_sizes",
 ]

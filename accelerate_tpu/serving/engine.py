@@ -15,7 +15,16 @@ against a fixed set of compiled executables (:mod:`.pool`):
 Everything dynamic lives on the host; the device only ever sees
 ``1 + len(prefill_buckets) + 1`` shapes (decode window, per-bucket prefill,
 insert), plus ``len(prefill_buckets)`` fixed copy shapes when the prefix
-cache is enabled.  See ``docs/usage/serving.md``.
+cache is enabled, plus one verify-window shape when ``speculate_k > 0``.
+See ``docs/usage/serving.md``.
+
+Speculative decoding (``speculate_k > 0``): each cycle the host proposes K
+draft tokens per lane by n-gram prompt-lookup (:mod:`.spec`) and, when at
+least one lane drafts, ONE verify forward over ``[slots, K+1]`` positions
+(:func:`.pool.make_verify_window`) lands 1..K+1 tokens per lane — greedy
+outputs token-exact vs plain decode, sampled outputs distribution-exact
+(Leviathan accept/resample).  Cycles with no draft fall back to the decode
+window, so non-repetitive workloads never regress.
 
 Prefix caching (:mod:`.prefix_cache`): freshly prefilled full chunks are
 retained as device KV slabs in a radix tree keyed by the token prefix; later
@@ -54,9 +63,11 @@ from .pool import (
     make_decode_window,
     make_insert,
     make_prefill_chunk,
+    make_verify_window,
 )
 from .prefix_cache import PrefixCache
 from .scheduler import Request, RequestState, Scheduler
+from .spec import propose_ngram_draft
 
 logger = get_logger(__name__)
 
@@ -90,6 +101,16 @@ class ServingEngine:
     prefix_cache_mb: byte budget (MiB) for the chunk-granular prefix KV cache
         (:mod:`.prefix_cache`); ``0``/``None`` disables it.  Requests opt out
         per-request via ``submit(..., cache_prefix=False)``.
+    speculate_k: draft length K for self-speculative decoding; ``0`` (the
+        default) disables it.  Cycles where at least one lane has an n-gram
+        draft run one verify forward over ``[slots, K+1]`` positions instead
+        of the decode window, landing 1..K+1 tokens per lane; draftless
+        cycles fall back to the decode window.  Greedy outputs are
+        token-exact either way; sampled outputs preserve the distribution
+        but not the sample stream.  Adds exactly one compiled executable.
+        Per-request opt-out: ``submit(..., speculate=False)``.
+    speculate_ngram: longest trailing n-gram the draft proposer tries
+        (:func:`~accelerate_tpu.serving.spec.propose_ngram_draft`).
     metrics_port: start (or join) the process-wide debug server
         (``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``)
         on this port; ``0`` binds an ephemeral port, ``None`` defers to
@@ -112,6 +133,8 @@ class ServingEngine:
         registry: Optional[MetricsRegistry] = None,
         prefix_cache_mb: Optional[float] = 64.0,
         metrics_port: Optional[int] = None,
+        speculate_k: int = 0,
+        speculate_ngram: int = 3,
     ):
         cfg = model.config
         self.model = model
@@ -137,6 +160,10 @@ class ServingEngine:
                 f"max_prompt_len {self.max_prompt_len}"
             )
         self.window = int(decode_window)
+        self.speculate_k = int(speculate_k)
+        if self.speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {speculate_k}")
+        self.speculate_ngram = int(speculate_ngram)
         self.pad_token_id = int(pad_token_id)
         if slot_order is None:
             slot_order = range(self.num_slots)
@@ -179,6 +206,14 @@ class ServingEngine:
         self._insert = RecompileWatchdog(
             make_insert(), name="serve/insert", budget=1, registry=self.metrics
         )
+        self._verify = (
+            RecompileWatchdog(
+                make_verify_window(model, self.speculate_k),
+                name="serve/verify_window", budget=1, registry=self.metrics,
+            )
+            if self.speculate_k
+            else None
+        )
         if prefix_cache_mb:
             self.prefix_cache: Optional[PrefixCache] = PrefixCache(
                 int(prefix_cache_mb * 2**20), registry=self.metrics
@@ -215,6 +250,10 @@ class ServingEngine:
         self._rngs = np.zeros((n, 2), np.uint32)
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._reserved_slot: Optional[int] = None
+        # device-resident mirror of the lane vectors above (uploaded lazily,
+        # invalidated only on admit/free) — steady-state decode/verify cycles
+        # ship zero lane-state host->device traffic
+        self._lane_device: Optional[list] = None
 
         self._next_rid = 0
         self._step_count = 0
@@ -232,6 +271,8 @@ class ServingEngine:
             "prefix_hit_tokens": 0,
             "prefix_miss_tokens": 0,
             "cancelled": 0,
+            "spec_drafted": 0,
+            "spec_accepted": 0,
         }
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
@@ -262,6 +303,11 @@ class ServingEngine:
             "serve/hbm_peak_bytes",
             help="largest per-executable HBM peak across the serving pool",
         )
+        self._accept_rate_gauge = self.metrics.gauge(
+            "serve/spec_accept_rate",
+            help="accepted / proposed draft tokens (cumulative) under "
+                 "speculative decoding",
+        )
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
@@ -274,13 +320,17 @@ class ServingEngine:
         config: Optional[GenerationConfig] = None,
         on_token: Optional[Callable[[Request, int], None]] = None,
         cache_prefix: bool = True,
+        speculate: bool = True,
         **overrides: Any,
     ) -> Request:
         """Queue one request; returns its :class:`Request` handle (filled in
         as the engine runs).  ``overrides`` patch the ``GenerationConfig``
         exactly like :func:`~accelerate_tpu.models.generation.generate`.
         ``cache_prefix=False`` opts this request out of prefix-KV reuse and
-        population (e.g. prompts carrying secrets that must not be retained)."""
+        population (e.g. prompts carrying secrets that must not be retained);
+        ``speculate=False`` opts it out of n-gram drafting (it still rides
+        along in verify windows other lanes trigger — with pad drafts, which
+        verification rejects)."""
         gen = config or GenerationConfig()
         if overrides:
             gen = dataclasses.replace(gen, **overrides)
@@ -291,17 +341,20 @@ class ServingEngine:
             raise ValueError(
                 f"prompt length {prompt.size} > max_prompt_len {self.max_prompt_len}"
             )
-        need = prompt.size + gen.max_new_tokens + self.window
+        # headroom for the widest device pass this engine can run: a verify
+        # cycle writes speculate_k + 1 KV positions in one forward
+        span = max(self.window, self.speculate_k + 1)
+        need = prompt.size + gen.max_new_tokens + span
         if need > self.max_len:
             raise ValueError(
                 f"prompt {prompt.size} + max_new_tokens {gen.max_new_tokens} + "
-                f"decode_window {self.window} = {need} exceeds slot capacity "
-                f"{self.max_len}"
+                f"max(decode_window, speculate_k + 1) {span} = {need} exceeds "
+                f"slot capacity {self.max_len}"
             )
         now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
                       submit_step=self._step_count, submit_time=now, last_token_time=now,
-                      cache_prefix=bool(cache_prefix))
+                      cache_prefix=bool(cache_prefix), speculate=bool(speculate))
         self._next_rid += 1
         self.scheduler.submit(req)
         self._bump("requests_submitted")
@@ -416,6 +469,7 @@ class ServingEngine:
             prompt_len=plen,
         )
         gen = req.config
+        self._lane_mark_dirty()
         self._pending_tok[s] = req.prompt[-1]
         self._active[s] = True
         self._eos[s] = -1 if gen.eos_token_id is None else gen.eos_token_id
@@ -437,7 +491,35 @@ class ServingEngine:
         req.state = RequestState.RUNNING
 
     # ----------------------------------------------------------------- decode
+    def _lane_mark_dirty(self) -> None:
+        """Invalidate the device-resident lane mirror before mutating host
+        lane state (admit/free).  The rng mirror is the one array the host
+        does NOT keep fresh between cycles (decode/verify carry it on
+        device), so it syncs back here — the only lane-state device->host
+        transfer outside token readback."""
+        if self._lane_device is not None:
+            self._rngs = np.array(jax.device_get(self._lane_device[-1]), np.uint32)
+            self._lane_device = None
+
+    def _lane_arrays(self) -> list:
+        """Device-resident lane vectors in decode/verify argument order
+        (pending, active, eos, do_sample, temperature, top_k, top_p, pad,
+        rngs).  Uploaded from the host mirrors only when marked dirty; the
+        pending-token and rng entries are refreshed in place from each
+        window's device-side outputs, so steady-state cycles upload nothing."""
+        if self._lane_device is None:
+            self._lane_device = [
+                jnp.asarray(self._pending_tok), jnp.asarray(self._active),
+                jnp.asarray(self._eos), jnp.asarray(self._do_sample),
+                jnp.asarray(self._temperature), jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
+                jnp.asarray(self._rngs),
+            ]
+        return self._lane_device
+
     def _free(self, slot: int, req: Request) -> None:
+        self._lane_mark_dirty()
         self._active[slot] = False
         self._slot_req[slot] = None
         req.state = RequestState.DONE
@@ -449,64 +531,137 @@ class ServingEngine:
         )
 
     def _decode_window(self) -> None:
+        """One decode phase over the pool: a speculative verify cycle when
+        any lane has an n-gram draft, the plain decode window otherwise."""
         if not self._active.any():
             return
         n_occupied = int(self._active.sum())
         self._occupancy_gauge.set(n_occupied / self.num_slots)
+        drafts = self._propose_drafts() if self.speculate_k else None
+        if drafts is not None:
+            self._verify_cycle(*drafts, n_occupied=n_occupied)
+        else:
+            self._decode_cycle(n_occupied)
+
+    def _decode_cycle(self, n_occupied: int) -> None:
+        lanes = self._lane_arrays()
         if not self.cost_table.captured("serve/decode_window"):
             self.cost_table.capture(
-                "serve/decode_window", self._decode,
-                (
-                    self.params, self.pool,
-                    jnp.asarray(self._pending_tok), jnp.asarray(self._active),
-                    jnp.asarray(self._eos), jnp.asarray(self._do_sample),
-                    jnp.asarray(self._temperature), jnp.asarray(self._top_k),
-                    jnp.asarray(self._top_p),
-                    jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
-                    jnp.asarray(self._rngs),
-                ),
+                "serve/decode_window", self._decode, (self.params, self.pool, *lanes)
             )
         with self.tracer.span("serve/decode_window", occupied=n_occupied):
-            self.pool, toks, rngs = self._decode(
-                self.params, self.pool,
-                jnp.asarray(self._pending_tok), jnp.asarray(self._active),
-                jnp.asarray(self._eos), jnp.asarray(self._do_sample),
-                jnp.asarray(self._temperature), jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
-                jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
-                jnp.asarray(self._rngs),
+            self.pool, toks, pending, rngs = self._decode(
+                self.params, self.pool, *lanes
             )
             toks = np.asarray(jax.device_get(toks))
-        # copy: device_get hands back read-only buffers, but _install writes
-        # per-slot keys into this array on admission
-        self._rngs = np.array(jax.device_get(rngs), np.uint32)
+        # the carried pending token / rng live on into the next cycle without
+        # touching the host (the host pending mirror is refreshed by _emit)
+        lanes[0], lanes[-1] = pending, rngs
         self._bump("decode_steps", self.window)
         self._bump("occupied_lane_steps", n_occupied * self.window)
+        self._emit(toks, np.full(self.num_slots, self.window))
+
+    def _propose_drafts(self):
+        """Host-side n-gram drafts for this cycle: ``(drafts [N, K], drafted
+        [N])`` or ``None`` when no active opted-in lane found a match (the
+        cycle falls back to the plain decode window).  Lanes without a match
+        carry pad drafts — verification rejects them, and the lane still
+        lands its >= 1 guaranteed token from the verify forward."""
+        k = self.speculate_k
+        drafts = np.full((self.num_slots, k), self.pad_token_id, np.int32)
+        drafted = np.zeros(self.num_slots, bool)
+        for s in np.nonzero(self._active)[0]:
+            req = self._slot_req[s]
+            if req is None or not req.speculate:
+                continue
+            d = propose_ngram_draft(
+                req.output_ids, k,
+                max_ngram=self.speculate_ngram, pad=self.pad_token_id,
+            )
+            if d is not None:
+                drafts[s] = d
+                drafted[s] = True
+        if not drafted.any():
+            return None
+        return drafts, drafted
+
+    def _verify_cycle(self, drafts: np.ndarray, drafted: np.ndarray,
+                      n_occupied: int) -> None:
+        k = self.speculate_k
+        lanes = self._lane_arrays()
+        # the host pending mirror is always fresh (updated by _emit); only
+        # the [N, K+1] token block uploads per verify cycle
+        tokens = jnp.asarray(
+            np.concatenate([self._pending_tok[:, None], drafts], axis=1)
+        )
+        if not self.cost_table.captured("serve/verify_window"):
+            self.cost_table.capture(
+                "serve/verify_window", self._verify,
+                (self.params, self.pool, tokens, *lanes[1:]),
+            )
+        n_drafted = int(drafted.sum())
+        with self.tracer.span("serve/verify_window", occupied=n_occupied,
+                              drafted=n_drafted):
+            self.pool, out, n_commit, pending, rngs = self._verify(
+                self.params, self.pool, tokens, *lanes[1:]
+            )
+            out = np.asarray(jax.device_get(out))
+            n_commit = np.asarray(jax.device_get(n_commit))
+        lanes[0], lanes[-1] = pending, rngs
+        self._bump("decode_steps", k + 1)
+        self._bump("occupied_lane_steps", n_occupied * (k + 1))
+        accepted = int(np.maximum(n_commit[drafted] - 1, 0).sum())
+        self._bump("spec_drafted", n_drafted * k)
+        self._bump("spec_accepted", accepted)
+        if self.stats["spec_drafted"]:
+            self._accept_rate_gauge.set(
+                self.stats["spec_accepted"] / self.stats["spec_drafted"]
+            )
+        self.recorder.record(
+            "serve/verify", step=self._step_count, drafted_lanes=n_drafted,
+            committed=int(n_commit.sum()), accepted=accepted,
+        )
+        self._emit(out, n_commit)
+
+    def _emit(self, toks: np.ndarray, counts: np.ndarray) -> None:
+        """Land device-produced tokens on their requests. ``toks[s, :counts[s]]``
+        is lane ``s``'s output this cycle (a full decode window, or a verify
+        cycle's committed prefix).  Per-lane take counts — EOS cut plus the
+        per-request length cap — are computed in one numpy pass so host time
+        stays flat in window size / speculate_k; only genuine per-request
+        bookkeeping (streaming callbacks, histograms, frees) runs in Python."""
+        width = toks.shape[1]
+        pos = np.arange(width)[None, :]
+        valid = (pos < np.asarray(counts).reshape(-1, 1)) & self._active[:, None]
+        is_eos = valid & (toks == self._eos[:, None]) & (self._eos >= 0)[:, None]
+        has_eos = is_eos.any(axis=1)
+        first_eos = np.where(has_eos, is_eos.argmax(axis=1), width)
+        n_take = np.minimum(valid.sum(axis=1), first_eos + 1)
         now = time.perf_counter()
-        emitted: dict = {}  # rid -> (request, tokens emitted this window)
-        for k in range(self.window):
-            for s in range(self.num_slots):
-                req = self._slot_req[s]
-                if req is None or not self._active[s]:
-                    continue
-                tok = int(toks[s, k])
-                finishing = req.finished(tok)
-                if not req.tokens:
-                    self._ttft_hist.observe(now - req.submit_time)
-                req.emit(tok)
-                emitted[req.rid] = (req, emitted.get(req.rid, (req, 0))[1] + 1)
-                self._bump("tokens_generated")
-                if finishing:
-                    self._free(s, req)
-                else:
-                    self._pending_tok[s] = tok
-        # a window lands W tokens per lane at once: charge each its amortized
-        # share of the wall time since the lane's previous arrival
-        for req, n_tok in emitted.values():
-            dt = max(now - req.last_token_time, 0.0) / n_tok
-            for _ in range(n_tok):
-                self._token_hist.observe(dt)
+        for s in np.nonzero(n_take > 0)[0]:
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            # the device can land more than the request's remaining budget in
+            # one verify cycle; the cap truncation below keeps outputs exactly
+            # what sequential decode would have produced
+            n = min(int(n_take[s]), req.config.max_new_tokens - len(req.tokens))
+            if n <= 0:
+                continue
+            if not req.tokens:
+                self._ttft_hist.observe(now - req.submit_time)
+            for t in toks[s, :n]:
+                req.emit(int(t))
+            self._bump("tokens_generated", n)
+            # a cycle lands n tokens on this lane at once: each is charged its
+            # amortized share of the wall time since the lane's last arrival
+            self._token_hist.observe(max(now - req.last_token_time, 0.0) / n, n)
             req.last_token_time = now
+            hit_eos = bool(has_eos[s]) and n == int(n_take[s])
+            if hit_eos or len(req.tokens) >= req.config.max_new_tokens:
+                self._free(s, req)
+            else:
+                self._pending_tok[s] = int(toks[s, n - 1])
 
     # ------------------------------------------------------------------ drive
     def step(self) -> None:
@@ -630,9 +785,13 @@ class ServingEngine:
     def compiled_executable_counts(self) -> dict:
         """Per-executable jit-cache sizes — the no-retrace contract: after any
         workload each entry is at most 1 (copy entries exist only while the
-        prefix cache is enabled, and stay 0 until the first hit)."""
+        prefix cache is enabled and stay 0 until the first hit; the
+        verify_window entry exists only when ``speculate_k > 0`` and stays 0
+        until the first drafted cycle)."""
         out = {"decode_window": jit_cache_sizes(self._decode),
                "insert": jit_cache_sizes(self._insert)}
+        if self._verify is not None:
+            out["verify_window"] = jit_cache_sizes(self._verify)
         for b, f in self._prefill.items():
             out[f"prefill_{b}"] = jit_cache_sizes(f)
         for b, f in self._copy.items():

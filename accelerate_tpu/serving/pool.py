@@ -29,11 +29,18 @@ per-request retracing:
   ``dynamic_update_slice`` of a cached prefix-KV slab (:mod:`.prefix_cache`)
   into the scratch cache at its index — a cache hit replays retained KV
   instead of re-running the prefill forward.
+* **verify window** (:func:`make_verify_window`) — one executable per
+  configured ``speculate_k``: a single forward over ``[slots, K+1]`` drafted
+  positions (pending token + K host-drafted tokens, :mod:`.spec`), the
+  token-exact acceptance prefix per lane, and an index rollback past the
+  first rejected draft.  Lands a variable 1..K+1 tokens per lane per call
+  while preserving exactly the tokens sequential decode would emit.
 
 Compiled-shape budget for an engine instance: ``1 (decode window) +
 len(prefill_buckets) + 1 (insert)``, plus ``len(prefill_buckets)`` copy
-executables when the prefix cache is enabled — asserted by the serving tests
-via the jit cache counters.
+executables when the prefix cache is enabled, plus ``1`` verify executable
+when ``speculate_k > 0`` — asserted by the serving tests via the jit cache
+counters.
 """
 
 from __future__ import annotations
@@ -54,7 +61,11 @@ def make_decode_window(model: Transformer, window: int):
 
     ``(params, cache, tokens [N], active [N], eos [N], do_sample [N],
     temperature [N], top_k [N], top_p [N], pad [N], rngs [N,2])
-    -> (cache, out_tokens [N, window], new_rngs)``
+    -> (cache, out_tokens [N, window], new_pending [N], new_rngs)``
+
+    ``new_pending`` is the scan's final carry token per lane — the token the
+    next window will feed — returned device-side so the engine's lane-state
+    mirrors never round-trip through the host between windows.
 
     Semantics per scan step (matching ``generate``'s loop body lane-by-lane):
     the pending token is fed at each lane's own position, its KV is written
@@ -86,12 +97,120 @@ def make_decode_window(model: Transformer, window: int):
             return (cache, nxt, done, split[:, 1]), nxt
 
         done0 = ~active
-        (cache, _, _, rngs), toks = jax.lax.scan(
+        (cache, tok, _, rngs), toks = jax.lax.scan(
             step, (cache, tokens, done0, rngs), None, length=window
         )
-        return cache, toks.T, rngs
+        return cache, toks.T, tok, rngs
 
     return decode_window
+
+
+def make_verify_window(model: Transformer, k: int):
+    """One jitted speculative verify pass: K+1 positions per lane, one forward.
+
+    ``(params, cache, tokens [N, K+1], active [N], eos [N], do_sample [N],
+    temperature [N], top_k [N], top_p [N], pad [N], rngs [N,2])
+    -> (cache, out [N, K+1], n_commit [N], new_pending [N], new_rngs)``
+
+    ``tokens[:, 0]`` is each lane's pending token, ``tokens[:, 1:]`` its K
+    host-drafted tokens (:mod:`.spec`).  The single forward writes KV for all
+    K+1 positions at each lane's own index and yields the true next-token
+    logits at every position; logits at position ``i`` are trustworthy iff
+    drafts ``1..i`` were all correct — exactly the prefix the acceptance rule
+    commits, so speculation never changes what gets emitted:
+
+    * **greedy lanes** — the committed token at each position is the argmax,
+      bitwise the same decision the decode window takes; a draft is accepted
+      while it equals that argmax (longest exact match).  Token-exact by
+      construction.
+    * **sampled lanes** — the Leviathan accept/resample rule specialized to a
+      deterministic (point-mass) drafter: draft ``d`` at position ``i`` is
+      accepted with probability ``p_i(d)`` under the *filtered* per-lane
+      distribution (same temperature/top-k/top-p pipeline as
+      :func:`~accelerate_tpu.models.generation.sample_tokens_batched`); on
+      rejection the committed token is resampled from ``p_i`` with ``d``
+      removed (the renormalized residual ``max(p - q, 0)``), which preserves
+      the output distribution exactly.  One bonus token is sampled at the
+      final position when every draft is accepted.
+
+    Committed tokens stop at the first emitted EOS; positions past the commit
+    point emit ``pad``.  The cache index rolls back to
+    ``prev_index + n_commit`` — KV for the pending token and accepted drafts
+    stays (it was computed from correct inputs), KV past the first rejection
+    is unreachable and gets overwritten by subsequent decode.  Frozen lanes
+    (``~active``) commit nothing and keep their index.
+    """
+    from ..models.generation import filter_logits_batched
+
+    kp1 = k + 1
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def verify_window(params, cache, tokens, active, eos, do_sample,
+                      temperature, top_k, top_p, pad, rngs):
+        n = tokens.shape[0]
+        prev_index = cache.index
+        logits, cache = model.apply({"params": params}, tokens, cache=cache)
+        logits = logits.astype(jnp.float32)                  # [N, K+1, V]
+        vocab = logits.shape[-1]
+        drafts = tokens[:, 1:]                               # [N, K]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        use_sample = do_sample & (temperature > 0.0)
+        split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+        draw_rngs, new_rngs = split[:, 0], split[:, 1]
+
+        def _greedy(_):
+            return greedy, greedy[:, :k] == drafts
+
+        def _sampled(_):
+            rep = lambda x: jnp.repeat(x, kp1, axis=0)
+            filt = filter_logits_batched(
+                logits.reshape(n * kp1, vocab),
+                temperature=rep(temperature), top_k=rep(top_k), top_p=rep(top_p),
+            ).reshape(n, kp1, vocab)
+            probs = jax.nn.softmax(filt, axis=-1)
+            # per lane: K accept draws + K residual resamples + 1 bonus draw
+            keys = jax.vmap(lambda r: jax.random.split(r, 2 * k + 1))(draw_rngs)
+            u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(keys[:, :k])
+            p_draft = jnp.take_along_axis(
+                probs[:, :k], drafts[..., None], axis=-1
+            )[..., 0]
+            accepted = u < p_draft                           # [N, K]
+            neg_inf = jnp.finfo(jnp.float32).min
+            residual = jnp.where(                            # p with the draft removed
+                jax.nn.one_hot(drafts, vocab, dtype=bool), neg_inf, filt[:, :k]
+            )
+            res = jax.vmap(jax.vmap(jax.random.categorical))(
+                keys[:, k:2 * k], residual
+            ).astype(jnp.int32)
+            bonus = jax.vmap(jax.random.categorical)(
+                keys[:, 2 * k], filt[:, k]
+            ).astype(jnp.int32)
+            emit = jnp.concatenate(
+                [jnp.where(accepted, drafts, res), bonus[:, None]], axis=1
+            )
+            emit = jnp.where(use_sample[:, None], emit, greedy)
+            acc = jnp.where(use_sample[:, None], accepted, greedy[:, :k] == drafts)
+            return emit, acc
+
+        # all-greedy pools (the common serving mix) skip the full-vocab
+        # filtering/sampling machinery at runtime, mirroring sample_tokens_batched
+        emit, acc = jax.lax.cond(jnp.any(use_sample), _sampled, _greedy, None)
+        n_accept = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+        pos = jnp.arange(kp1)[None, :]
+        committable = pos <= n_accept[:, None]
+        is_eos = (emit == eos[:, None]) & (eos >= 0)[:, None]
+        eos_before = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos) > 0
+        commit = committable & ~eos_before & active[:, None]
+        n_commit = commit.sum(axis=1).astype(jnp.int32)
+        out = jnp.where(commit, emit, pad[:, None])
+        # model.apply advanced every lane by K+1; roll back past rejections
+        # (and fully, for frozen lanes — their garbage writes are unreachable)
+        cache = cache.replace(index=prev_index + n_commit)
+        last = jnp.maximum(n_commit - 1, 0)
+        new_pending = jnp.take_along_axis(out, last[:, None], axis=1)[:, 0]
+        return cache, out, n_commit, new_pending, new_rngs
+
+    return verify_window
 
 
 def make_prefill_chunk(model: Transformer, chunk_len: int):

@@ -65,6 +65,11 @@ class Request:
     # ``cache_chain_broken`` stops population once a chunk could not be
     # retained (a later chunk without its ancestors would be unreachable).
     cache_prefix: bool = True
+    # per-request speculative-decoding opt-out: when False the engine never
+    # drafts for this request's lane even with ``speculate_k > 0`` (it still
+    # rides along in verify windows other lanes trigger — with pad drafts,
+    # which verification simply rejects)
+    speculate: bool = True
     cached_chunks: int = 0
     cache_nodes: List[Any] = dataclasses.field(default_factory=list)
     cache_chain_broken: bool = False

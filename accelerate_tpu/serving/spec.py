@@ -1,0 +1,78 @@
+"""Host-side draft proposal for self-speculative decoding.
+
+Decode is memory-bandwidth-bound: one model forward per emitted token per
+lane reads the full weight set to produce a single row of logits, leaving the
+MXU idle (`serve/decode_flops_per_token` vs the chip's HBM peak makes the gap
+visible).  Speculative decoding (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding") closes it by *verifying* K cheaply
+drafted tokens in ONE batched forward: the verify pass computes the true
+next-token distribution at every drafted position, and an accept/commit rule
+keeps the output distribution exactly what non-speculative decode would have
+produced — for greedy decode, token-for-token identical.
+
+The drafter here is **prompt-lookup / n-gram matching** (the draft-model-free
+scheme popularized by vLLM's ngram speculator): each lane's draft is the
+continuation of the most recent earlier occurrence of its trailing n-gram in
+its own context (prompt + generated tokens).  No second model, no extra
+params, no device work — a numpy suffix match per lane per cycle.  It shines
+on repetitive or structured output (code, JSON, extraction, long quotes of
+the prompt) where the continuation literally already appears in the context,
+and degrades to nothing on high-entropy text — which is why the engine falls
+back to the plain decode window whenever no lane drafts.
+
+Device-side verification lives in :func:`~.pool.make_verify_window`; the
+engine (:mod:`.engine`) wires the two together per cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def propose_ngram_draft(
+    context: np.ndarray,
+    k: int,
+    max_ngram: int = 3,
+    min_ngram: int = 1,
+    pad: int = 0,
+) -> Optional[np.ndarray]:
+    """Draft ``k`` tokens by prompt-lookup: find the most recent earlier
+    occurrence of the longest trailing n-gram of ``context`` and return the
+    tokens that followed it.
+
+    Tries n-gram sizes from ``max_ngram`` down to ``min_ngram`` (longer
+    matches draft with higher acceptance).  The match must end strictly
+    before the context's tail (the trailing n-gram itself never matches) and
+    have at least one following token.
+
+    A match at lag ``L`` from the tail implies the context is locally
+    periodic with period ``L``, so the draft extends *cyclically*:
+    ``draft[j] = context[start + (j % L)]``.  For matches deep in the
+    context this is just the ``k`` literal follower tokens; for the common
+    steady-state case — generation locked into a cycle shorter than ``k``,
+    where the most recent match sits one period from the tail — it predicts
+    whole future periods instead of running out of context (drafting past
+    the end and padding would cap acceptance at the cycle length).
+
+    Returns the ``[k]`` int32 draft, or ``None`` when no n-gram recurs —
+    the caller falls back to ordinary decode for this lane.  ``pad`` is
+    accepted for signature stability but never needed (cyclic extension
+    always fills all ``k`` slots).
+    """
+    context = np.ascontiguousarray(context, dtype=np.int32)
+    n_ctx = int(context.size)
+    if k <= 0 or min_ngram < 1 or n_ctx < min_ngram + 1:
+        return None
+    for n in range(min(max_ngram, n_ctx - 1), min_ngram - 1, -1):
+        tail = context[n_ctx - n:]
+        # candidate windows start at 0..n_ctx-n-2: they end strictly before
+        # the tail starts a new copy AND leave >= 1 token to draft from
+        windows = np.lib.stride_tricks.sliding_window_view(context[: n_ctx - 1], n)
+        hits = np.nonzero((windows == tail).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1]) + n          # most recent match wins
+            lag = n_ctx - start                # local period implied by the match
+            return context[start + (np.arange(k) % lag)]
+    return None

@@ -146,13 +146,16 @@ class Histogram:
         self._min = math.inf
         self._max = -math.inf
 
-    def observe(self, value: float) -> None:
-        if not _ENABLED:
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``value``; ``n > 1`` records it ``n`` times in one update
+        (the serving emit path lands a whole window/verify batch of identical
+        amortized latencies without a per-token Python loop)."""
+        if not _ENABLED or n < 1:
             return
         value = float(value)
-        self._counts[bisect.bisect_left(self._bounds, value)] += 1
-        self._count += 1
-        self._sum += value
+        self._counts[bisect.bisect_left(self._bounds, value)] += n
+        self._count += n
+        self._sum += value * n
         if value < self._min:
             self._min = value
         if value > self._max:

@@ -22,6 +22,12 @@ HBM while the MXU computes.  Tasks:
   reclaim.  HBM-resident weights (serving is not an offload bench); reports
   tokens/s, per-token latency percentiles, slot occupancy, and ``vs_baseline``
   = engine tokens/s over static tokens/s.
+* ``--task spec`` — speculative decoding A/B: the SAME serving engine with
+  ``speculate_k`` on vs off over a repetitive (tiled-motif) greedy workload —
+  n-gram drafting's home turf.  Outputs must be token-identical between the
+  runs (the bench hard-fails otherwise; verification is exact), and
+  ``vs_baseline`` = speculation-on tokens/s over speculation-off, with the
+  draft-acceptance rate in ``detail``.
 
 Either way ``effective stream GB/s`` — model bytes transferred per step / wall
 time — is the engine-quality number; ``vs_baseline`` compares it to the
@@ -151,6 +157,130 @@ def _shared_prefix_result(args, preset, shared, prompt_lens, out_lens,
     detail.update(_cost_detail(eng, dt_on))
     return {
         "metric": "serving_prefix_cache_tokens_per_sec",
+        "value": round(tps_on, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_on / tps_off, 3),
+        "detail": detail,
+    }
+
+
+def _spec_bench(args, model, cfg, params, preset):
+    """Speculation on vs off on a repetitive greedy workload (one JSON result).
+
+    The speculation-off engine is the baseline — identical requests, identical
+    executables minus the verify window — so ``vs_baseline`` isolates exactly
+    what n-gram drafting + batched verification buy.  The workload is tiled
+    short motifs (the structured/repetitive shape — code, JSON, quoting — that
+    prompt-lookup drafting targets); greedy outputs must be token-identical
+    between the two runs and the bench hard-fails if they are not.
+    """
+    import dataclasses
+
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.models.transformer import Transformer
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)  # HBM-resident: speculation is a decode bench
+    slots = args.batch
+    window = args.decode_window
+    k = args.speculate_k
+    if k < 1:
+        raise SystemExit("--task spec needs --speculate-k >= 1")
+    max_len = cfg.max_seq_len
+    mp = max(8, min(args.seq, max_len) // 2)
+    buckets = tuple(sorted({max(8, mp // 4), max(8, mp // 2)}))
+    span = max(window, k + 1)
+
+    # Speculation pays off in the steady state — once generation locks into
+    # the motif's cycle, drafts verify near-perfectly — so the bench wants
+    # generations long enough for steady state to dominate the chaotic
+    # opening tokens.  Rope params carry no position table, so the context
+    # window can be widened to fit the requested generation with the SAME
+    # weights (both A/B arms get the identical widened model).
+    need = mp + args.spec_new_tokens + span
+    if need > max_len and cfg.positional == "rope":
+        max_len = min(need, 1024)
+        cfg = dataclasses.replace(cfg, max_seq_len=max_len)
+        model = Transformer(cfg)
+
+    r = np.random.default_rng(args.serve_seed)
+    out_len = int(min(args.spec_new_tokens, max_len - mp - span))
+    prompts = []
+    for _ in range(args.requests):
+        motif = r.integers(1, cfg.vocab_size, (int(r.integers(3, 8)),)).astype(np.int32)
+        prompts.append(np.tile(motif, mp // motif.size + 1)[:mp])
+    gen = GenerationConfig(max_new_tokens=out_len)
+    useful_tokens = args.requests * out_len
+    slot_len = min(max_len, mp + out_len + span)
+
+    def run(spec_k):
+        """One warmed, timed engine pass (prefix cache off: one variable)."""
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            model, params, num_slots=slots, max_len=slot_len,
+            prefill_buckets=buckets, max_prompt_len=mp, decode_window=window,
+            registry=registry, prefix_cache_mb=0, speculate_k=spec_k,
+        )
+        # warmup compiles every executable before timing: non-drafting random
+        # prompts exercise each prefill bucket + insert + the decode window;
+        # a tiled prompt drives the verify window when speculation is on
+        for b in buckets:
+            eng.submit(r.integers(1, cfg.vocab_size, (b,)).astype(np.int32),
+                       config=GenerationConfig(max_new_tokens=2 * span),
+                       speculate=False)
+            eng.run()
+        eng.submit(np.tile(np.arange(1, 4, dtype=np.int32), mp)[:mp],
+                   config=GenerationConfig(max_new_tokens=2 * span))
+        eng.run()
+        for key in eng.stats:
+            eng.stats[key] = 0
+        registry.reset()
+        t0 = time.perf_counter()
+        reqs = eng.serve(prompts, gen)
+        dt = time.perf_counter() - t0
+        return eng, reqs, dt, registry
+
+    eng_on, reqs_on, dt_on, registry = run(k)
+    eng_off, reqs_off, dt_off, _ = run(0)
+    if [q.tokens for q in reqs_on] != [q.tokens for q in reqs_off]:
+        raise SystemExit(
+            "speculative decoding changed greedy outputs: speculation-on "
+            "tokens differ from speculation-off on the same workload"
+        )
+    tps_on = useful_tokens / dt_on
+    tps_off = useful_tokens / dt_off
+    drafted = eng_on.stats["spec_drafted"]
+    accepted = eng_on.stats["spec_accepted"]
+    tok = registry.get("serve/token_latency_s").snapshot()
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": args.requests,
+        "num_slots": slots,
+        "decode_window": window,
+        "speculate_k": k,
+        "prompt_len": mp,
+        "new_tokens_per_request": out_len,
+        "useful_tokens": useful_tokens,
+        "spec_on_wall_s": round(dt_on, 3),
+        "spec_off_wall_s": round(dt_off, 3),
+        "spec_off_tokens_per_s": round(tps_off, 2),
+        "spec_accept_rate": round(accepted / drafted, 3) if drafted else 0.0,
+        "spec_drafted": drafted,
+        "spec_accepted": accepted,
+        "outputs_token_identical": True,
+        "token_latency_p50_ms": round(1e3 * tok["p50"], 2),
+        "token_latency_p99_ms": round(1e3 * tok["p99"], 2),
+        "compiled_executables": eng_on.compiled_executable_counts(),
+        "watchdog_over_budget": any(
+            wd.over_budget()
+            for wd in [eng_on._decode, eng_on._verify, eng_on._insert,
+                       *eng_on._prefill.values()]
+        ),
+    }
+    return {
+        "metric": "serving_speculative_tokens_per_sec",
         "value": round(tps_on, 2),
         "unit": "tokens/s",
         "vs_baseline": round(tps_on / tps_off, 3),
@@ -347,7 +477,8 @@ def _serve_bench(args, model, cfg, params, preset):
 def main():
     presets = _presets()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--task", choices=["decode", "prefill", "serve"], default="decode")
+    parser.add_argument("--task", choices=["decode", "prefill", "serve", "spec"],
+                        default="decode")
     parser.add_argument("--requests", type=int, default=16,
                         help="serve task: total queued requests (depth > --batch slots)")
     parser.add_argument("--decode_window", type=int, default=8,
@@ -362,6 +493,12 @@ def main():
                         default=64.0,
                         help="serve task: prefix KV cache byte budget (MiB) for "
                              "the --shared-prefix run")
+    parser.add_argument("--speculate-k", dest="speculate_k", type=int, default=8,
+                        help="spec task: draft tokens verified per cycle")
+    parser.add_argument("--spec_new_tokens", type=int, default=384,
+                        help="spec task: generated tokens per request (long "
+                             "enough for greedy decode to settle into the "
+                             "repetitive pattern drafting exploits)")
     parser.add_argument("--preset", choices=list(presets), default=None,
                         help="default: small on TPU, tiny elsewhere (gpt2-xl = parity geometry)")
     parser.add_argument("--batch", type=int, default=8)
@@ -443,11 +580,12 @@ def main():
             host_leaves.append((r.standard_normal(leaf.shape, dtype=np.float32) * 0.02).astype(jnp.bfloat16))
         params = jax.tree_util.tree_unflatten(treedef, host_leaves)
 
-    if args.task == "serve":
+    if args.task in ("serve", "spec"):
         if args.bits is not None:
-            raise SystemExit("--task serve benches HBM-resident decode; --bits "
-                             "applies to the streaming tasks")
-        result = _serve_bench(args, model, cfg, params, preset)
+            raise SystemExit(f"--task {args.task} benches HBM-resident decode; "
+                             "--bits applies to the streaming tasks")
+        bench = _serve_bench if args.task == "serve" else _spec_bench
+        result = bench(args, model, cfg, params, preset)
         print(json.dumps(result))
         return
 

@@ -19,6 +19,7 @@ from accelerate_tpu.models.transformer import KVCache, Transformer, TransformerC
 from accelerate_tpu.serving import PrefixCache, ServingEngine, RequestState
 from accelerate_tpu.serving.pool import plan_chunks
 from accelerate_tpu.serving.prefix_cache import rolling_hash
+from accelerate_tpu.serving.spec import propose_ngram_draft
 from accelerate_tpu.telemetry import MetricsRegistry
 from accelerate_tpu.utils.jax_compat import jit_cache_supported
 
@@ -543,3 +544,159 @@ class TestCancel:
         assert node.refs == 1               # pinned by the submit-time match
         assert eng.cancel(req)
         assert node.refs == 0
+
+
+class TestNgramDraft:
+    """Host-side prompt-lookup drafting in isolation (pure numpy)."""
+
+    def test_most_recent_match_and_continuation(self):
+        ctx = np.array([1, 2, 3, 9, 1, 2, 3], np.int32)
+        assert propose_ngram_draft(ctx, 2).tolist() == [9, 1]
+        # the trailing trigram recurs twice; the most recent copy wins
+        ctx = np.array([1, 2, 3, 4, 1, 2, 3, 5, 1, 2, 3], np.int32)
+        assert propose_ngram_draft(ctx, 1).tolist() == [5]
+
+    def test_short_continuation_extends_cyclically(self):
+        # match one period from the tail: the draft wraps around the cycle
+        # instead of running out of context
+        d = propose_ngram_draft(np.array([1, 2, 1, 2], np.int32), 3)
+        assert d.tolist() == [1, 2, 1]
+        d = propose_ngram_draft(np.array([7, 3, 4, 3, 4], np.int32), 6)
+        assert d.tolist() == [3, 4, 3, 4, 3, 4]
+
+    def test_minimal_and_degenerate_contexts(self):
+        # the shortest drafting context: a repeated unigram
+        assert propose_ngram_draft(np.array([5, 5], np.int32), 1).tolist() == [5]
+        assert propose_ngram_draft(np.array([5], np.int32), 2) is None
+        assert propose_ngram_draft(np.array([5, 5], np.int32), 0) is None
+
+    def test_no_recurrence_returns_none(self):
+        assert propose_ngram_draft(np.array([1, 2, 3, 4], np.int32), 2) is None
+
+
+class TestSpeculative:
+    """Speculative decoding: invisible in greedy outputs, visible in stats."""
+
+    def _workload(self, model, rng):
+        vocab = model.config.vocab_size
+        # two heavily self-repetitive prompts (n-gram drafting's home turf)
+        # interleaved with a random one (the fallback path)
+        rep_a = np.tile(rng.integers(1, vocab, (5,)), 4)[:16].astype(np.int32)
+        rep_b = np.tile(rng.integers(1, vocab, (3,)), 5).astype(np.int32)
+        return [rep_a, rng.integers(1, vocab, (9,)).astype(np.int32), rep_b]
+
+    def test_greedy_token_exact_across_k(self):
+        """speculate_k in {0, 2, 4} — and the static ``generate`` reference —
+        all produce byte-identical greedy tokens (prefix cache on)."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(31)
+        prompts = self._workload(model, rng)
+        gens = [GenerationConfig(max_new_tokens=n, eos_token_id=1)
+                for n in (12, 8, 10)]
+        outs = {}
+        for k in (0, 2, 4):
+            eng = _engine(model, params, speculate_k=k)
+            reqs = eng.serve(prompts, gens)
+            outs[k] = [r.tokens for r in reqs]
+            if k:
+                assert eng.stats["spec_drafted"] > 0
+        assert outs[0] == outs[2] == outs[4]
+        for toks, prompt, gen in zip(outs[0], prompts, gens):
+            assert toks == _expected(model, params, prompt, gen)
+
+    def test_token_exact_with_cancel_mid_stream(self):
+        """Cancelling a queued request under speculation leaves every other
+        request's tokens exactly what the non-speculative engine produces."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(32)
+        prompts = self._workload(model, rng)
+        gen = GenerationConfig(max_new_tokens=8)
+        results = {}
+        for k in (0, 3):
+            eng = _engine(model, params, num_slots=1, decode_window=1,
+                          speculate_k=k)
+            reqs = [eng.submit(p, config=gen) for p in prompts]
+            eng.step()                       # request 0 mid-stream, 1/2 queued
+            assert eng.cancel(reqs[1])
+            eng.run()
+            assert reqs[1].state is RequestState.CANCELLED
+            results[k] = [reqs[0].tokens, reqs[2].tokens]
+        assert results[0] == results[3]
+        assert results[0][0] == _expected(model, params, prompts[0], gen)
+        assert results[0][1] == _expected(model, params, prompts[2], gen)
+
+    def test_compiled_budget_adds_exactly_one_verify_executable(self):
+        if not jit_cache_supported():
+            pytest.skip("this jax hides the pjit executable-cache counter")
+        model, params = _tiny_model()
+        rng = np.random.default_rng(33)
+        prompts = self._workload(model, rng)
+        gens = [GenerationConfig(max_new_tokens=n) for n in (10, 6, 8)]
+        eng = _engine(model, params, speculate_k=3)
+        eng.serve(prompts, gens)
+        # mixed drafted + fallback cycles ran; exactly ONE verify signature
+        assert eng.stats["spec_drafted"] > 0
+        assert eng.compiled_executable_counts() == {
+            "decode_window": 1, "insert": 1, "verify_window": 1,
+            "prefill_4": 1, "prefill_8": 1, "copy_4": 0, "copy_8": 0,
+        }
+        assert not eng._verify.over_budget()
+
+    def test_per_request_opt_out(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(34)
+        prompts = self._workload(model, rng)
+        gen = GenerationConfig(max_new_tokens=8)
+        eng = _engine(model, params, speculate_k=3)
+        reqs = [eng.submit(p, config=gen, speculate=False) for p in prompts]
+        eng.run()
+        # nobody drafted, so every cycle fell back to the decode window
+        assert eng.stats["spec_drafted"] == 0
+        counts = eng.compiled_executable_counts()
+        assert counts["verify_window"] == 0 and counts["decode_window"] == 1
+        for req, prompt in zip(reqs, prompts):
+            assert req.tokens == _expected(model, params, prompt, gen)
+
+    def test_spec_metrics_flow_through_registry(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(35)
+        reg = MetricsRegistry()
+        eng = _engine(model, params, registry=reg, speculate_k=3)
+        eng.serve(self._workload(model, rng),
+                  GenerationConfig(max_new_tokens=10))
+        snap = reg.snapshot()
+        assert snap["serve/spec_drafted_total"] == eng.stats["spec_drafted"] > 0
+        assert snap["serve/spec_accepted_total"] == eng.stats["spec_accepted"]
+        assert 0.0 < snap["serve/spec_accept_rate"] <= 1.0
+        assert snap["serve/spec_accept_rate"] == pytest.approx(
+            eng.stats["spec_accepted"] / eng.stats["spec_drafted"]
+        )
+        # token-latency samples still equal tokens generated (the amortized
+        # accounting must count 1..K+1 landed tokens per lane per cycle)
+        assert snap["serve/token_latency_s"]["count"] == eng.stats["tokens_generated"]
+
+    def test_sampled_speculation_is_deterministic_and_in_vocab(self):
+        """Sampled lanes under speculation: the accept/resample rule preserves
+        the output *distribution*, not the sample stream — so we pin what is
+        guaranteed: per-seed determinism and valid tokens."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(36)
+        prompts = self._workload(model, rng)
+        gen = GenerationConfig(max_new_tokens=8, do_sample=True, temperature=0.8)
+        runs = []
+        for _ in range(2):
+            eng = _engine(model, params, speculate_k=3, rng_seed=123)
+            reqs = eng.serve(prompts, gen)
+            for r in reqs:
+                assert len(r.tokens) == 8
+                assert all(0 <= t < model.config.vocab_size for t in r.tokens)
+            runs.append([r.tokens for r in reqs])
+        assert runs[0] == runs[1]
+
+    def test_capacity_check_covers_verify_span(self):
+        model, params = _tiny_model()
+        eng = _engine(model, params, decode_window=2, speculate_k=7)
+        # max(window, k + 1) = 8: an 8-token prompt + 49 new > 64 capacity
+        with pytest.raises(ValueError, match="speculate_k"):
+            eng.submit(np.ones(8, np.int32), max_new_tokens=49)
+        eng.submit(np.ones(8, np.int32), max_new_tokens=48)

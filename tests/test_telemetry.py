@@ -98,6 +98,21 @@ class TestHistogram:
         assert h.snapshot() == {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
                                 "max": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
 
+    def test_bulk_observe_equals_repeated_observe(self):
+        """observe(v, n) is exactly n observe(v) calls in one update — the
+        serving emit path's whole-window recording."""
+        buckets = (0.5, 1.0, 2.0)
+        bulk, loop = Histogram("b", buckets=buckets), Histogram("l", buckets=buckets)
+        for v, n in ((0.3, 4), (1.5, 1), (9.0, 3)):
+            bulk.observe(v, n)
+            for _ in range(n):
+                loop.observe(v)
+        assert bulk.snapshot() == loop.snapshot()
+        assert bulk.count == 8
+        bulk.observe(0.1, 0)   # n < 1 records nothing
+        bulk.observe(0.1, -2)
+        assert bulk.count == 8
+
 
 class TestRegistry:
     def test_get_or_create_idempotent_and_type_checked(self):
