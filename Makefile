@@ -49,6 +49,7 @@ bench:
 bench-serve:
 	python bench_inference.py --task serve
 	python bench_inference.py --task serve --shared-prefix 16
+	python bench_inference.py --task serve --paged-ab
 	python bench_inference.py --task spec
 
 quality:

@@ -1,14 +1,21 @@
 """Continuous-batching serving: slot-based KV pool, in-flight admission,
 chunked prefill — iteration-level scheduling (Orca; vLLM's slot reuse) kept
-inside a fixed set of compiled TPU executables.  See ``docs/usage/serving.md``.
+inside a fixed set of compiled TPU executables.  With ``paged=True`` the KV
+pool becomes a refcounted page pool behind per-lane block tables
+(:mod:`.paging` — PagedAttention, TPU-native).  See ``docs/usage/serving.md``.
 """
 
 from .engine import ServingEngine
+from .paging import NULL_PAGE, PageAllocator, PagedKVPool
 from .pool import (
     jit_cache_sizes,
     make_copy_chunk,
+    make_copy_page,
     make_decode_window,
     make_insert,
+    make_paged_decode_window,
+    make_paged_prefill_chunk,
+    make_paged_verify_window,
     make_prefill_chunk,
     make_verify_window,
     plan_chunks,
@@ -25,12 +32,19 @@ __all__ = [
     "PrefixCache",
     "PrefixNode",
     "rolling_hash",
+    "NULL_PAGE",
+    "PageAllocator",
+    "PagedKVPool",
     "plan_chunks",
     "make_decode_window",
     "make_verify_window",
     "make_prefill_chunk",
     "make_insert",
     "make_copy_chunk",
+    "make_paged_decode_window",
+    "make_paged_verify_window",
+    "make_paged_prefill_chunk",
+    "make_copy_page",
     "propose_ngram_draft",
     "jit_cache_sizes",
 ]

@@ -37,6 +37,7 @@ skipped; the decode path never changes.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -57,13 +58,19 @@ from ..telemetry import (
     get_tracer,
     start_debug_server,
 )
+from .paging import PagedKVPool
 from .pool import (
     jit_cache_sizes,
     make_copy_chunk,
+    make_copy_page,
     make_decode_window,
     make_insert,
+    make_paged_decode_window,
+    make_paged_prefill_chunk,
+    make_paged_verify_window,
     make_prefill_chunk,
     make_verify_window,
+    plan_chunks,
 )
 from .prefix_cache import PrefixCache
 from .scheduler import Request, RequestState, Scheduler
@@ -115,6 +122,23 @@ class ServingEngine:
         (``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``)
         on this port; ``0`` binds an ephemeral port, ``None`` defers to
         ``ATPU_METRICS_PORT`` (off when unset).
+    paged: run the KV pool as a refcounted *page pool* with per-lane block
+        tables (:mod:`.paging`) instead of per-lane ``max_len`` slabs.  Pages
+        are allocated as lanes grow, prefix-cache hits alias shared pages with
+        ZERO copies (copy-on-write only on a shared tail page), and page
+        pressure preempts the youngest lane — it releases its pages and
+        requeues for replay through the prefix cache.  Greedy outputs are
+        token-identical paged on/off (the gathered view is exactly the slab
+        shape, so the attention program is bitwise the same; keep
+        ``max_prompt_len == max_len``, the default, for strict identity).
+    page_size: tokens per KV page (paged mode).  Must divide every prefill
+        bucket and ``max_len``; default ``gcd(prefill_buckets)`` — the prefix
+        cache's chunk granularity.
+    num_pages: physical pages in the pool (paged mode), the knob that trades
+        HBM for concurrency: lanes only consume pages they actually use, so
+        ``num_pages`` can be far below ``num_slots * max_len / page_size``
+        under mixed-length traffic.  Default is the no-preemption worst case
+        (``num_slots * max_len / page_size + 1``).
     """
 
     def __init__(
@@ -135,6 +159,9 @@ class ServingEngine:
         metrics_port: Optional[int] = None,
         speculate_k: int = 0,
         speculate_ngram: int = 3,
+        paged: bool = False,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
     ):
         cfg = model.config
         self.model = model
@@ -173,10 +200,43 @@ class ServingEngine:
                 f"slot_order must permute range({self.num_slots}), got {self.slot_order}"
             )
 
-        # device state: the pool (per-lane index) + the batch-1 prefill scratch
-        self.pool = KVCache.create(cfg, self.num_slots, self.max_len, per_lane_index=True)
-        self.scratch = KVCache.create(cfg, 1, self.max_prompt_len)
+        self.paged = bool(paged)
+        if self.paged:
+            self.page_size = int(
+                page_size if page_size is not None
+                else math.gcd(*self.buckets) if len(self.buckets) > 1
+                else self.buckets[0]
+            )
+            for b in self.buckets:
+                if b % self.page_size != 0:
+                    raise ValueError(
+                        f"page_size {self.page_size} must divide every prefill "
+                        f"bucket, got {self.buckets}"
+                    )
+            if self.max_len % self.page_size != 0:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide max_len {self.max_len}"
+                )
+            self.num_pages = int(
+                num_pages if num_pages is not None
+                else self.num_slots * (self.max_len // self.page_size) + 1
+            )
         self.metrics = registry if registry is not None else get_registry()
+        # device state: per-lane-index slab pool + batch-1 prefill scratch
+        # (legacy), or the shared page pool + host block tables (paged — no
+        # scratch at all: prefill gathers the lane's own view, shared prefix
+        # pages included, and scatters freshly written pages back)
+        if self.paged:
+            self.pool = None
+            self.scratch = None
+            self.kv = PagedKVPool(
+                cfg, self.num_slots, self.max_len, self.page_size,
+                self.num_pages, registry=self.metrics,
+            )
+        else:
+            self.pool = KVCache.create(cfg, self.num_slots, self.max_len, per_lane_index=True)
+            self.scratch = KVCache.create(cfg, 1, self.max_prompt_len)
+            self.kv = None
         self.tracer = get_tracer()
         # Forensics + cost accounting (docs/usage/observability.md): request
         # lifecycle events land in the process flight recorder, per-executable
@@ -193,38 +253,60 @@ class ServingEngine:
         # budget=1 per executable: the engine's whole design promises exactly
         # one compiled shape each — any second signature is a bug worth a warning
         self._decode = RecompileWatchdog(
-            make_decode_window(model, self.window),
+            make_paged_decode_window(model, self.window) if self.paged
+            else make_decode_window(model, self.window),
             name="serve/decode_window", budget=1, registry=self.metrics,
         )
         self._prefill = {
             b: RecompileWatchdog(
-                make_prefill_chunk(model, b),
+                make_paged_prefill_chunk(model, b, self.page_size) if self.paged
+                else make_prefill_chunk(model, b),
                 name=f"serve/prefill_{b}", budget=1, registry=self.metrics,
             )
             for b in self.buckets
         }
-        self._insert = RecompileWatchdog(
-            make_insert(), name="serve/insert", budget=1, registry=self.metrics
+        self._insert = (
+            None if self.paged
+            else RecompileWatchdog(
+                make_insert(), name="serve/insert", budget=1, registry=self.metrics
+            )
         )
         self._verify = (
             RecompileWatchdog(
-                make_verify_window(model, self.speculate_k),
+                make_paged_verify_window(model, self.speculate_k) if self.paged
+                else make_verify_window(model, self.speculate_k),
                 name="serve/verify_window", budget=1, registry=self.metrics,
             )
             if self.speculate_k
             else None
         )
+        self._copy_page = (
+            RecompileWatchdog(
+                make_copy_page(), name="serve/copy_page", budget=1,
+                registry=self.metrics,
+            )
+            if self.paged
+            else None
+        )
         if prefix_cache_mb:
             self.prefix_cache: Optional[PrefixCache] = PrefixCache(
-                int(prefix_cache_mb * 2**20), registry=self.metrics
+                int(prefix_cache_mb * 2**20), registry=self.metrics,
+                on_evict=self._on_prefix_evict if self.paged else None,
             )
-            self._copy = {
-                b: RecompileWatchdog(
-                    make_copy_chunk(b),
-                    name=f"serve/copy_{b}", budget=1, registry=self.metrics,
-                )
-                for b in self.buckets
-            }
+            # paged hits alias pages through the block table — no copy
+            # executables exist; legacy replays slabs through one
+            # dynamic_update_slice shape per bucket
+            self._copy = (
+                {}
+                if self.paged
+                else {
+                    b: RecompileWatchdog(
+                        make_copy_chunk(b),
+                        name=f"serve/copy_{b}", budget=1, registry=self.metrics,
+                    )
+                    for b in self.buckets
+                }
+            )
         else:
             self.prefix_cache = None
             self._copy = {}
@@ -248,6 +330,13 @@ class ServingEngine:
         self._top_k = np.zeros(n, np.int32)
         self._top_p = np.ones(n, np.float32)
         self._rngs = np.zeros((n, 2), np.uint32)
+        # host mirror of each lane's KV write index (paged mode): install sets
+        # it to prompt_len - 1, decode/verify advance it by exactly what the
+        # device committed — integer arithmetic, so the mirror is always exact
+        self._lane_len = np.zeros(n, np.int32)
+        #: high-water mark of simultaneously active lanes (the paged-vs-slab
+        #: concurrency headline; tracked in both modes for A/B benches)
+        self.peak_active_lanes = 0
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._reserved_slot: Optional[int] = None
         # device-resident mirror of the lane vectors above (uploaded lazily,
@@ -273,6 +362,8 @@ class ServingEngine:
             "cancelled": 0,
             "spec_drafted": 0,
             "spec_accepted": 0,
+            "preemptions": 0,
+            "cow_copies": 0,
         }
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
@@ -351,6 +442,16 @@ class ServingEngine:
                 f"max(decode_window, speculate_k + 1) {span} = {need} exceeds "
                 f"slot capacity {self.max_len}"
             )
+        # the chunk plan pads the final chunk up to its bucket; that padding
+        # must still fit the prefill write target (the scratch cache, or the
+        # paged lane view) or the tail writes would silently clamp/corrupt
+        padded = sum(b for b, _ in plan_chunks(prompt.size, self.buckets))
+        cap = self.max_len if self.paged else self.max_prompt_len
+        if padded > cap:
+            raise ValueError(
+                f"prompt {prompt.size} pads to {padded} prefill tokens under "
+                f"buckets {self.buckets}, exceeding capacity {cap}"
+            )
         now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
                       submit_step=self._step_count, submit_time=now, last_token_time=now,
@@ -361,18 +462,39 @@ class ServingEngine:
         return req
 
     def cancel(self, request) -> bool:
-        """Cancel a still-queued request (a :class:`Request` or its rid).
+        """Cancel a queued OR running request (a :class:`Request` or its rid).
 
-        Only requests that have not begun prefilling can be dropped — they
-        have burned no prefill budget and hold no slot.  Returns True when
-        the request was dequeued (state becomes ``CANCELLED``); False when it
-        is already prefilling, running, done, or unknown."""
+        Queued requests are dropped before burning any prefill budget; a
+        RUNNING lane is frozen immediately — it stops decoding this very
+        step, its slot frees for the next admission, and in paged mode every
+        KV page it held returns to the allocator (shared prefix pages survive
+        under the cache's own references).  Tokens already streamed stay
+        streamed.  Returns True when the request was cancelled (state becomes
+        ``CANCELLED``); False when it is mid-prefill, done, or unknown."""
         rid = request.rid if isinstance(request, Request) else int(request)
         req = self.scheduler.cancel(rid)
-        if req is None:
-            return False
-        self._bump("cancelled")
-        return True
+        if req is not None:
+            self._bump("cancelled")
+            return True
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if req is None or req.rid != rid or not self._active[s]:
+                continue
+            self._lane_mark_dirty()
+            self._active[s] = False
+            self._slot_req[s] = None
+            if self.paged:
+                self.kv.lane_release(s)
+                self._lane_len[s] = 0
+            req.state = RequestState.CANCELLED
+            req.finish_step = self._step_count
+            self._bump("cancelled")
+            self.recorder.record(
+                "serve/cancel_running", rid=rid, slot=s, step=self._step_count,
+                tokens=len(req.tokens),
+            )
+            return True
+        return False
 
     # -------------------------------------------------------------- admission
     def _next_free_slot(self) -> Optional[int]:
@@ -388,89 +510,284 @@ class ServingEngine:
                 slot = self._next_free_slot()
                 if slot is None or not self.scheduler.queue:
                     return
+                if self.paged and not self._admission_pages_ok(self.scheduler.queue[0]):
+                    return
                 self.scheduler.start_next(slot)
                 self._reserved_slot = slot
-                # scratch restarts at position 0; stale KV beyond each new
-                # write is unreachable (causal mask == valid-entry mask)
-                self.scratch = self.scratch.replace(index=jnp.zeros((), jnp.int32))
+                if not self.paged:
+                    # scratch restarts at position 0; stale KV beyond each new
+                    # write is unreachable (causal mask == valid-entry mask)
+                    self.scratch = self.scratch.replace(index=jnp.zeros((), jnp.int32))
+            if self.paged and not self._ensure_prefill_pages():
+                return  # page pressure: pause prefill, retry next step
             took = self.scheduler.take_chunk(budget)
             if took is None:
                 return
             req, bucket, valid, start, cached = took
+            ptoks = req.prefill_tokens
             if cached:
-                # replay the retained slab: one dynamic_update_slice at the
-                # scratch index, zero budget charged (no forward pass ran)
                 node = req.cache_nodes[req.next_chunk - 1]
-                self.cost_table.capture(
-                    f"serve/copy_{bucket}", self._copy[bucket],
-                    (self.scratch, node.k, node.v),
-                )
-                with self.tracer.span("serve/copy_chunk", bucket=bucket, start=start):
-                    self.scratch = self._copy[bucket](self.scratch, node.k, node.v)
+                if self.paged:
+                    # the zero-copy hit: alias the node's physical pages into
+                    # this lane's block table — no device work at all
+                    self.kv.lane_append_shared(req.slot, node.pages)
+                else:
+                    # replay the retained slab: one dynamic_update_slice at the
+                    # scratch index, zero budget charged (no forward pass ran)
+                    self.cost_table.capture(
+                        f"serve/copy_{bucket}", self._copy[bucket],
+                        (self.scratch, node.k, node.v),
+                    )
+                    with self.tracer.span("serve/copy_chunk", bucket=bucket, start=start):
+                        self.scratch = self._copy[bucket](self.scratch, node.k, node.v)
                 self._bump("prefix_hit_tokens", valid)
             else:
                 chunk = np.zeros(bucket, np.int32)
-                chunk[:valid] = req.prompt[start:start + valid]
-                self.cost_table.capture(
-                    f"serve/prefill_{bucket}", self._prefill[bucket],
-                    (self.params, chunk[None], self.scratch),
-                )
-                with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
-                    self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
+                chunk[:valid] = ptoks[start:start + valid]
+                if self.paged:
+                    self._paged_prefill_chunk(req, bucket, valid, chunk, start)
+                else:
+                    self.cost_table.capture(
+                        f"serve/prefill_{bucket}", self._prefill[bucket],
+                        (self.params, chunk[None], self.scratch),
+                    )
+                    with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
+                        self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
                 budget -= bucket
                 self._bump("prefill_chunks")
                 if self.prefix_cache is not None and req.cache_prefix:
                     self._bump("prefix_miss_tokens", valid)
-                    self._populate_cache(req, bucket, valid, start)
+                    self._populate_cache(req, bucket, valid, start, ptoks)
             self._bump("prefill_tokens", valid)
             done = self.scheduler.finish_prefill()
             if done is not None:
                 self._install(done)
 
-    def _populate_cache(self, req: Request, bucket: int, valid: int, start: int) -> None:
+    # ---------------------------------------------------------- paged admission
+    def _on_prefix_evict(self, node) -> None:
+        """Prefix-cache eviction hook (paged mode): drop the cache's allocator
+        reference on each retained page.  Pages still aliased by running lanes
+        survive; unreferenced ones return to the free list."""
+        if node.pages:
+            self.kv.allocator.deref(node.pages)
+
+    def _admission_pages_ok(self, req: Request) -> bool:
+        """Can the queue head's whole prefill be paged in?  Conservative
+        (cached chunks alias pages and cost nothing; the count uses the match
+        from submit, which admission may improve).  Reclaims WITHOUT
+        preemption — evicting a running lane to admit behind it would invert
+        FCFS and can livelock under steady overload."""
+        padded = sum(b for b, _ in req.chunks)
+        cached = sum(b for b, _ in req.chunks[:req.cached_chunks])
+        need = (padded - cached) // self.page_size
+        if self.kv.allocator.free_count >= need:
+            return True
+        return self._reclaim_pages(need, allow_preempt=False)
+
+    def _ensure_prefill_pages(self) -> bool:
+        """Pages for the prefilling request's NEXT chunk (called before
+        ``take_chunk``).  False pauses prefill for this engine step — running
+        lanes keep decoding, their completions free pages, and the stalled
+        chunk retries next step."""
+        req = self.scheduler.prefilling
+        if req is None or req.next_chunk >= len(req.chunks):
+            return True
+        if req.next_chunk < req.cached_chunks:
+            return True  # cached chunk: aliases resident pages, allocates none
+        bucket, _ = req.chunks[req.next_chunk]
+        need = bucket // self.page_size
+        if self.kv.allocator.free_count >= need:
+            return True
+        return self._reclaim_pages(need, allow_preempt=False)
+
+    def _paged_prefill_chunk(self, req: Request, bucket: int, valid: int,
+                             chunk: np.ndarray, start: int) -> None:
+        """Prefill one fresh chunk straight into newly allocated lane pages.
+        The executable gathers the lane's full view — shared prefix pages
+        included, which is how a partial hit feeds context to the chunks after
+        it — and scatters back only the chunk's own (page-aligned) span."""
+        s = req.slot
+        ids = self.kv.allocator.alloc(bucket // self.page_size)
+        if ids is None:  # _ensure_prefill_pages runs first; this cannot happen
+            raise RuntimeError("KV page pool exhausted mid-prefill")
+        self.kv.lane_append_owned(s, ids)
+        kv = self.kv
+        table = jnp.asarray(kv.tables[s])
+        self.cost_table.capture(
+            f"serve/prefill_{bucket}", self._prefill[bucket],
+            (self.params, chunk[None], kv.pages_k, kv.pages_v, table,
+             jnp.int32(start)),
+        )
+        with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
+            kv.pages_k, kv.pages_v = self._prefill[bucket](
+                self.params, chunk[None], kv.pages_k, kv.pages_v, table,
+                jnp.int32(start),
+            )
+
+    def _reclaim_pages(self, need: int, allow_preempt: bool) -> bool:
+        """Recover free pages until at least ``need`` are available.  The
+        ladder, cheapest first: (1) evict unpinned prefix-cache leaves —
+        dropping the cache's reference frees any page no lane still aliases;
+        (2) preempt the youngest running lane (its pages free NOW; it requeues
+        at the front and replays through the cache); (3) strip queued
+        requests' cache pins so step 1 can reach more leaves.  Returns False
+        when the ladder is exhausted short of ``need``."""
+        while self.kv.allocator.free_count < need:
+            if self.prefix_cache is not None and self.prefix_cache.evict_one():
+                continue
+            if allow_preempt and self._preempt():
+                continue
+            if self.scheduler.drop_cache_pins() > 0:
+                continue
+            return False
+        return True
+
+    def _preempt(self) -> bool:
+        """Preempt the youngest replayable running lane: release its pages,
+        requeue it at the FRONT for replay over prompt + generated tokens
+        (ideally hitting the cache chunks it populated in its first life).
+        Youngest-first keeps FCFS intact — the last admitted is the first
+        sacrificed.  Returns False with no replayable victim."""
+        victims = sorted(
+            (s for s in np.nonzero(self._active)[0] if self._slot_req[s] is not None),
+            key=lambda s: self._slot_req[s].rid, reverse=True,
+        )
+        for s in victims:
+            req = self._slot_req[s]
+            eff = len(req.prefill_tokens)
+            padded = sum(b for b, _ in plan_chunks(eff, self.buckets))
+            if eff > self.max_prompt_len or padded > self.max_len:
+                continue  # grew past replayability (max_prompt_len < max_len)
+            self._lane_mark_dirty()
+            self._active[s] = False
+            self._slot_req[s] = None
+            freed = self.kv.lane_release(s)
+            self._lane_len[s] = 0
+            self.scheduler.requeue(req)
+            self._bump("preemptions")
+            self.recorder.record(
+                "serve/preempt", rid=req.rid, slot=int(s), step=self._step_count,
+                pages_freed=freed, effective_len=eff,
+            )
+            return True
+        return False
+
+    def _ensure_decode_capacity(self, width: int) -> None:
+        """Map pages for every active lane's next ``width`` KV writes
+        (positions ``lane_len .. lane_len + width - 1``).  Under pressure the
+        full reclaim ladder runs, preemption included — the youngest lane
+        funds the older ones, and if a lane preempts ITSELF the loop simply
+        moves on (its pages are already free)."""
+        page = self.page_size
+        for s in np.nonzero(self._active)[0]:
+            need = (int(self._lane_len[s]) + width - 1) // page + 1
+            while self._active[s]:
+                missing = need - int(self.kv.lane_npages[s])
+                if missing <= 0:
+                    break
+                ids = self.kv.allocator.alloc(missing)
+                if ids is not None:
+                    self.kv.lane_append_owned(s, ids)
+                    break
+                if not self._reclaim_pages(missing, allow_preempt=True):
+                    raise RuntimeError(
+                        "KV page pool exhausted: no cache leaf, lane, or pin "
+                        "left to reclaim for a decoding lane"
+                    )
+
+    def _populate_cache(self, req: Request, bucket: int, valid: int, start: int,
+                        ptoks: np.ndarray) -> None:
         """Retain a freshly prefilled FULL chunk in the prefix cache.
 
-        The slab slice ``scratch[:, :, start:start+bucket]`` is an eager
-        device-side copy (a handful of static offsets per geometry, never a
-        per-request shape).  Padded final chunks are skipped — their KV past
-        ``valid`` is garbage — and once one chunk fails to retain (budget or
-        collision) the rest of the request's chain is abandoned: a child
-        without its ancestors could never be matched.
+        Legacy: the slab slice ``scratch[:, :, start:start+bucket]`` is an
+        eager device-side copy (a handful of static offsets per geometry,
+        never a per-request shape).  Paged: zero copies — the cache node
+        records the lane's own physical page ids and takes one allocator
+        reference per page, so the KV outlives the lane.  Padded final chunks
+        are skipped — their KV past ``valid`` is garbage — and once one chunk
+        fails to retain (budget or collision) the rest of the request's chain
+        is abandoned: a child without its ancestors could never be matched.
         """
         if valid != bucket or req.cache_chain_broken:
             return
         parent = req.cache_nodes[-1] if req.cache_nodes else None
-        node = self.prefix_cache.insert(
-            parent, req.prompt[start:start + bucket],
-            self.scratch.k[:, :, start:start + bucket],
-            self.scratch.v[:, :, start:start + bucket],
-        )
+        if self.paged:
+            npg = bucket // self.page_size
+            ids = self.kv.chunk_ids(req.slot, start // self.page_size, npg)
+            node = self.prefix_cache.insert_pages(
+                parent, ptoks[start:start + bucket], ids,
+                nbytes=npg * self.kv.page_kv_bytes,
+            )
+            if node is not None and node.pages == tuple(ids):
+                # a NEW node was created: the cache holds its own reference
+                # per page (dropped by _on_prefix_evict); a deduped re-insert
+                # keeps the resident node's pages and refs untouched
+                self.kv.allocator.ref(ids)
+        else:
+            node = self.prefix_cache.insert(
+                parent, ptoks[start:start + bucket],
+                self.scratch.k[:, :, start:start + bucket],
+                self.scratch.v[:, :, start:start + bucket],
+            )
         if node is None:
             req.cache_chain_broken = True
         else:
             self.prefix_cache.acquire([node])
             req.cache_nodes.append(node)
 
+    def _cow_tail_page(self, s: int, plen: int) -> None:
+        """Copy-on-write for the single spot sharing and writing can collide:
+        the page holding position ``plen - 1``, the lane's first decode-write
+        target.  Chunk starts are page-aligned (buckets are multiples of the
+        page size), so every OTHER shared page lies strictly before the write
+        frontier and every later page is freshly allocated.  Re-checks after
+        each reclaim — eviction can dissolve the sharing and make the copy
+        unnecessary."""
+        pslot = (plen - 1) // self.page_size
+        pid = int(self.kv.tables[s, pslot])
+        while int(self.kv.allocator.refs[pid]) > 1:
+            new = self.kv.allocator.alloc(1)
+            if new is None:
+                if not self._reclaim_pages(1, allow_preempt=True):
+                    raise RuntimeError("KV page pool exhausted during copy-on-write")
+                continue
+            kv = self.kv
+            with self.tracer.span("serve/copy_page", src=pid, dst=new[0]):
+                kv.pages_k, kv.pages_v = self._copy_page(
+                    kv.pages_k, kv.pages_v, jnp.int32(pid), jnp.int32(new[0])
+                )
+            kv.lane_replace(s, pslot, new[0])
+            self._bump("cow_copies")
+            return
+
     def _install(self, req: Request) -> None:
-        """Insert a fully prefilled request into its reserved slot: one
-        ``dynamic_update_slice`` into the pool + host lane-state updates."""
+        """Hand a fully prefilled request its lane.  Legacy: one
+        ``dynamic_update_slice`` of the scratch slab into the pool.  Paged:
+        the lane's pages ARE the prefilled KV — nothing moves; only the
+        shared tail page (if any) is copy-on-write duplicated before decode
+        starts writing at ``plen - 1``."""
         s = req.slot
-        plen = len(req.prompt)
-        self.cost_table.capture(
-            "serve/insert", self._insert,
-            (self.pool, self.scratch.k, self.scratch.v, jnp.int32(s), jnp.int32(plen - 1)),
-        )
-        self.pool = self._insert(
-            self.pool, self.scratch.k, self.scratch.v,
-            jnp.int32(s), jnp.int32(plen - 1),
-        )
+        ptoks = req.prefill_tokens
+        plen = len(ptoks)
+        if self.paged:
+            self._cow_tail_page(s, plen)
+            self._lane_len[s] = plen - 1
+        else:
+            self.cost_table.capture(
+                "serve/insert", self._insert,
+                (self.pool, self.scratch.k, self.scratch.v, jnp.int32(s), jnp.int32(plen - 1)),
+            )
+            self.pool = self._insert(
+                self.pool, self.scratch.k, self.scratch.v,
+                jnp.int32(s), jnp.int32(plen - 1),
+            )
         self.recorder.record(
             "serve/install", rid=req.rid, slot=s, step=self._step_count,
             prompt_len=plen,
         )
         gen = req.config
         self._lane_mark_dirty()
-        self._pending_tok[s] = req.prompt[-1]
+        self._pending_tok[s] = ptoks[-1]
         self._active[s] = True
         self._eos[s] = -1 if gen.eos_token_id is None else gen.eos_token_id
         self._do_sample[s] = gen.do_sample
@@ -522,6 +839,9 @@ class ServingEngine:
         self._lane_mark_dirty()
         self._active[slot] = False
         self._slot_req[slot] = None
+        if self.paged:
+            self.kv.lane_release(slot)
+            self._lane_len[slot] = 0
         req.state = RequestState.DONE
         req.finish_step = self._step_count
         self._bump("requests_completed")
@@ -535,7 +855,15 @@ class ServingEngine:
         any lane has an n-gram draft, the plain decode window otherwise."""
         if not self._active.any():
             return
+        if self.paged:
+            # map pages for the widest pass this cycle could run (the same
+            # span the admission check reserved headroom for); this may
+            # preempt the youngest lane under pressure, so re-check occupancy
+            self._ensure_decode_capacity(max(self.window, self.speculate_k + 1))
+            if not self._active.any():
+                return
         n_occupied = int(self._active.sum())
+        self.peak_active_lanes = max(self.peak_active_lanes, n_occupied)
         self._occupancy_gauge.set(n_occupied / self.num_slots)
         drafts = self._propose_drafts() if self.speculate_k else None
         if drafts is not None:
@@ -545,15 +873,33 @@ class ServingEngine:
 
     def _decode_cycle(self, n_occupied: int) -> None:
         lanes = self._lane_arrays()
-        if not self.cost_table.captured("serve/decode_window"):
-            self.cost_table.capture(
-                "serve/decode_window", self._decode, (self.params, self.pool, *lanes)
-            )
-        with self.tracer.span("serve/decode_window", occupied=n_occupied):
-            self.pool, toks, pending, rngs = self._decode(
-                self.params, self.pool, *lanes
-            )
-            toks = np.asarray(jax.device_get(toks))
+        if self.paged:
+            kv = self.kv
+            # block tables + write indices ride up fresh each cycle (a few KB
+            # of int32 — allocation is host-side and can change every cycle)
+            tables = jnp.asarray(kv.tables)
+            index = jnp.asarray(self._lane_len)
+            if not self.cost_table.captured("serve/decode_window"):
+                self.cost_table.capture(
+                    "serve/decode_window", self._decode,
+                    (self.params, kv.pages_k, kv.pages_v, tables, index, *lanes),
+                )
+            with self.tracer.span("serve/decode_window", occupied=n_occupied):
+                kv.pages_k, kv.pages_v, toks, pending, rngs = self._decode(
+                    self.params, kv.pages_k, kv.pages_v, tables, index, *lanes
+                )
+                toks = np.asarray(jax.device_get(toks))
+            self._lane_len[self._active] += self.window
+        else:
+            if not self.cost_table.captured("serve/decode_window"):
+                self.cost_table.capture(
+                    "serve/decode_window", self._decode, (self.params, self.pool, *lanes)
+                )
+            with self.tracer.span("serve/decode_window", occupied=n_occupied):
+                self.pool, toks, pending, rngs = self._decode(
+                    self.params, self.pool, *lanes
+                )
+                toks = np.asarray(jax.device_get(toks))
         # the carried pending token / rng live on into the next cycle without
         # touching the host (the host pending mirror is refreshed by _emit)
         lanes[0], lanes[-1] = pending, rngs
@@ -594,19 +940,39 @@ class ServingEngine:
         tokens = jnp.asarray(
             np.concatenate([self._pending_tok[:, None], drafts], axis=1)
         )
-        if not self.cost_table.captured("serve/verify_window"):
-            self.cost_table.capture(
-                "serve/verify_window", self._verify,
-                (self.params, self.pool, tokens, *lanes[1:]),
-            )
         n_drafted = int(drafted.sum())
-        with self.tracer.span("serve/verify_window", occupied=n_occupied,
-                              drafted=n_drafted):
-            self.pool, out, n_commit, pending, rngs = self._verify(
-                self.params, self.pool, tokens, *lanes[1:]
-            )
-            out = np.asarray(jax.device_get(out))
-            n_commit = np.asarray(jax.device_get(n_commit))
+        if self.paged:
+            kv = self.kv
+            tables = jnp.asarray(kv.tables)
+            index = jnp.asarray(self._lane_len)
+            if not self.cost_table.captured("serve/verify_window"):
+                self.cost_table.capture(
+                    "serve/verify_window", self._verify,
+                    (self.params, kv.pages_k, kv.pages_v, tables, index,
+                     tokens, *lanes[1:]),
+                )
+            with self.tracer.span("serve/verify_window", occupied=n_occupied,
+                                  drafted=n_drafted):
+                kv.pages_k, kv.pages_v, out, n_commit, pending, rngs = self._verify(
+                    self.params, kv.pages_k, kv.pages_v, tables, index,
+                    tokens, *lanes[1:]
+                )
+                out = np.asarray(jax.device_get(out))
+                n_commit = np.asarray(jax.device_get(n_commit))
+            self._lane_len[self._active] += n_commit[self._active]
+        else:
+            if not self.cost_table.captured("serve/verify_window"):
+                self.cost_table.capture(
+                    "serve/verify_window", self._verify,
+                    (self.params, self.pool, tokens, *lanes[1:]),
+                )
+            with self.tracer.span("serve/verify_window", occupied=n_occupied,
+                                  drafted=n_drafted):
+                self.pool, out, n_commit, pending, rngs = self._verify(
+                    self.params, self.pool, tokens, *lanes[1:]
+                )
+                out = np.asarray(jax.device_get(out))
+                n_commit = np.asarray(jax.device_get(n_commit))
         lanes[0], lanes[-1] = pending, rngs
         self._bump("decode_steps", k + 1)
         self._bump("occupied_lane_steps", n_occupied * (k + 1))
@@ -675,6 +1041,8 @@ class ServingEngine:
             if covered:
                 self._hit_rate_gauge.set(self.stats["prefix_hit_tokens"] / covered)
         self._decode_window()
+        if self.paged:
+            self.kv.publish_gauges()
         self._step_count += 1
         # Progress heartbeat for the stall detector / /healthz; also the
         # ring's per-step record of what the pool looked like.
@@ -782,14 +1150,29 @@ class ServingEngine:
             self._hbm_gauge.set(hbm)
         return snap
 
+    def kv_pool_bytes(self) -> int:
+        """Device HBM the KV state occupies: the whole page pool (paged — the
+        knob ``num_pages`` sizes), or the slab pool plus the prefill scratch
+        (legacy).  The A/B bench holds this equal across both arms."""
+        if self.paged:
+            return self.kv.kv_bytes()
+        return (int(self.pool.k.nbytes) + int(self.pool.v.nbytes)
+                + int(self.scratch.k.nbytes) + int(self.scratch.v.nbytes))
+
     def compiled_executable_counts(self) -> dict:
         """Per-executable jit-cache sizes — the no-retrace contract: after any
         workload each entry is at most 1 (copy entries exist only while the
         prefix cache is enabled and stay 0 until the first hit; the
         verify_window entry exists only when ``speculate_k > 0`` and stays 0
-        until the first drafted cycle)."""
-        out = {"decode_window": jit_cache_sizes(self._decode),
-               "insert": jit_cache_sizes(self._insert)}
+        until the first drafted cycle).  Paged mode swaps insert and the
+        per-bucket copies for a single ``copy_page`` (0 until the first
+        copy-on-write); cache hits alias pages, so the hit path adds no
+        executable at all."""
+        out = {"decode_window": jit_cache_sizes(self._decode)}
+        if self.paged:
+            out["copy_page"] = jit_cache_sizes(self._copy_page)
+        else:
+            out["insert"] = jit_cache_sizes(self._insert)
         if self._verify is not None:
             out["verify_window"] = jit_cache_sizes(self._verify)
         for b, f in self._prefill.items():

@@ -1,0 +1,236 @@
+"""Paged KV allocator: one physical page pool behind every lane AND the prefix cache.
+
+The slot pool gives each lane a contiguous ``max_len`` KV slab — worst-case
+memory reserved up front, so mixed-length traffic caps concurrency at
+``HBM / max_len`` lanes even when most requests are short.  vLLM's
+PagedAttention breaks that: KV lives in fixed-size *pages*, a lane owns a
+block table mapping logical positions to physical pages, pages are allocated
+as the lane grows, and refcounting lets many lanes alias the same physical
+page.  The TPU-native translation here keeps every device program fixed-shape
+(:mod:`.pool` grows exactly one gather/scatter executable per existing shape)
+while all allocation, refcounting, and copy-on-write stay host-side numpy:
+
+* :class:`PageAllocator` — the refcounted free list.  Page id ``0`` is the
+  reserved **null page**: freed or frozen lanes' garbage writes land there
+  (their block-table rows are reset to null), so no compiled program ever
+  needs a "has pages?" branch.
+* :class:`PagedKVPool` — the device-resident page arrays
+  ``[L, num_pages, page_size, Hkv, Dh]`` plus per-lane block tables
+  (host ``[num_slots, pages_per_lane]`` int32, uploaded per cycle — a few KB).
+  ``pages_per_lane * page_size == max_len`` exactly: the gathered per-lane
+  view has the *same* width as the legacy slab, so paged decode runs the
+  bitwise-identical attention program (a wider view would change the softmax
+  reduction shape and with it the last-ulp rounding — measured, not
+  hypothetical).
+
+Sharing model: the prefix cache pins pages (one allocator ref per caching
+node), every lane aliasing a cached prefix takes its own ref per page, and a
+page returns to the free list only at refcount zero.  Copy-on-write happens in
+exactly one place — the page holding a lane's first decode-write position
+(``prompt_len - 1``) when that page is shared — everything a lane writes after
+that lands in pages it owns alone.
+
+Telemetry (documented in ``docs/usage/observability.md``):
+``serve/kv_pages_in_use``, ``serve/kv_pages_free`` and
+``serve/kv_bytes_shared`` published by :meth:`PagedKVPool.publish_gauges`;
+``serve/preemptions_total`` is counted by the engine when page pressure forces
+a lane to release its pages and requeue for replay.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry import MetricsRegistry, get_registry
+
+#: Reserved garbage-sink page id. Never allocated, never freed; block-table
+#: rows of inactive lanes point here so frozen-lane writes have a harmless
+#: destination and gathers read finite (zero-initialised) values.
+NULL_PAGE = 0
+
+
+class PageAllocator:
+    """Refcounted free-list allocator over ``num_pages`` physical pages.
+
+    Page 0 is the permanently-pinned null page (:data:`NULL_PAGE`).  The free
+    list hands out ascending ids deterministically — allocation order is part
+    of the engine's reproducibility story (same workload, same tables).
+    """
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        if self.num_pages < 2:
+            raise ValueError(f"need at least 2 pages (null + 1), got {num_pages}")
+        self.refs = np.zeros(self.num_pages, np.int64)
+        self.refs[NULL_PAGE] = 1  # never allocatable, never freed
+        # pop() takes from the tail: ids come out ascending (1, 2, 3, ...)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        """Allocated pages (null excluded)."""
+        return self.num_pages - 1 - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` pages (refcount 1 each) or ``None`` — all-or-nothing, so
+        a partial grab under pressure never leaks pages."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self.refs[ids] += 1
+        return ids
+
+    def ref(self, ids: Sequence[int]) -> None:
+        """One more reference on each of ``ids`` (aliasing a shared prefix)."""
+        for p in ids:
+            if self.refs[p] <= 0:
+                raise RuntimeError(f"ref() on unallocated page {p}")
+            self.refs[p] += 1
+
+    def deref(self, ids: Sequence[int]) -> int:
+        """Drop one reference per page; pages hitting zero return to the free
+        list.  Returns how many pages were actually freed."""
+        freed = 0
+        for p in ids:
+            if p == NULL_PAGE:
+                continue
+            self.refs[p] -= 1
+            if self.refs[p] < 0:
+                raise RuntimeError(f"page {p} refcount underflow")
+            if self.refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    def shared_extra_refs(self) -> int:
+        """Σ max(refs - 1, 0) over real pages: how many page-copies sharing is
+        saving right now (the ``serve/kv_bytes_shared`` numerator)."""
+        return int(np.maximum(self.refs[1:] - 1, 0).sum())
+
+
+class PagedKVPool:
+    """Device page arrays + host block tables for ``num_slots`` lanes.
+
+    Parameters
+    ----------
+    config: the model's ``TransformerConfig`` (layer/head/dim geometry; pages
+        use ``config.dtype`` exactly like the legacy slab pool).
+    num_slots: lane count (the decode batch dimension).
+    max_len: per-lane logical KV capacity.  Must be a multiple of
+        ``page_size`` — the gathered view is exactly this wide, which is what
+        makes paged decode bitwise-identical to the contiguous slab.
+    page_size: tokens per page (the prefix-cache chunk granularity must be a
+        multiple of it; the engine uses gcd(prefill buckets) by default).
+    num_pages: physical pages including the null page.  Must be at least
+        ``max_len // page_size + 1`` so a single lane can always run to its
+        capacity even with nothing else to reclaim.
+    """
+
+    def __init__(self, config, num_slots: int, max_len: int, page_size: int,
+                 num_pages: int, registry: Optional[MetricsRegistry] = None):
+        if max_len % page_size != 0:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size {page_size} "
+                f"(the gathered view must match the legacy slab width exactly)"
+            )
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.num_slots = int(num_slots)
+        self.pages_per_lane = self.max_len // self.page_size
+        self.num_pages = int(num_pages)
+        if self.num_pages < self.pages_per_lane + 1:
+            raise ValueError(
+                f"num_pages {num_pages} cannot hold one full lane "
+                f"({self.pages_per_lane} pages) plus the null page"
+            )
+        cfg = config
+        shape = (cfg.num_layers, self.num_pages, self.page_size,
+                 cfg.num_kv_heads, cfg.resolved_head_dim)
+        self.pages_k = jnp.zeros(shape, cfg.dtype)
+        self.pages_v = jnp.zeros(shape, cfg.dtype)
+        #: bytes of k+v one page holds — the sharing/HBM accounting unit
+        self.page_kv_bytes = 2 * int(
+            np.prod(shape[2:]) * cfg.num_layers * jnp.zeros((), cfg.dtype).itemsize
+        )
+        self.allocator = PageAllocator(self.num_pages)
+        # host block tables: row s maps lane s's logical page slots to
+        # physical ids; NULL_PAGE marks unmapped (garbage-sink) entries
+        self.tables = np.zeros((self.num_slots, self.pages_per_lane), np.int32)
+        self.lane_npages = np.zeros(self.num_slots, np.int32)
+
+        registry = registry if registry is not None else get_registry()
+        self._in_use_gauge = registry.gauge(
+            "serve/kv_pages_in_use", help="allocated KV pages (null page excluded)"
+        )
+        self._free_gauge = registry.gauge(
+            "serve/kv_pages_free", help="KV pages on the free list"
+        )
+        self._shared_gauge = registry.gauge(
+            "serve/kv_bytes_shared",
+            help="KV bytes extra references alias instead of copying "
+                 "(sum of (refs-1) * page_bytes over shared pages)",
+        )
+        self.publish_gauges()
+
+    # -------------------------------------------------------------- lane ops
+    def lane_append_owned(self, slot: int, ids: Sequence[int]) -> None:
+        """Map freshly allocated pages (refcount already 1, owned by caller —
+        ownership transfers to the lane) onto the next logical slots."""
+        n = self.lane_npages[slot]
+        for i, p in enumerate(ids):
+            self.tables[slot, n + i] = p
+        self.lane_npages[slot] = n + len(ids)
+
+    def lane_append_shared(self, slot: int, ids: Sequence[int]) -> None:
+        """Alias already-resident pages (a prefix-cache hit): takes one new
+        reference per page, then maps them.  Zero device work — this IS the
+        zero-copy hit path."""
+        self.allocator.ref(ids)
+        self.lane_append_owned(slot, ids)
+
+    def lane_replace(self, slot: int, page_slot: int, new_id: int) -> int:
+        """Copy-on-write bookkeeping: swap one logical slot to ``new_id``
+        (already allocated by the caller) and drop the lane's reference on the
+        old physical page.  Returns the old id (the copy source)."""
+        old = int(self.tables[slot, page_slot])
+        self.tables[slot, page_slot] = new_id
+        self.allocator.deref([old])
+        return old
+
+    def lane_release(self, slot: int) -> int:
+        """Unmap the whole lane (finish / cancel / preempt): deref every
+        mapped page and reset the row to the null sink.  Returns pages freed."""
+        n = int(self.lane_npages[slot])
+        freed = self.allocator.deref([int(p) for p in self.tables[slot, :n]])
+        self.tables[slot, :] = NULL_PAGE
+        self.lane_npages[slot] = 0
+        return freed
+
+    def chunk_ids(self, slot: int, start_page: int, n: int) -> List[int]:
+        """Physical ids backing ``n`` logical page slots from ``start_page``
+        (what the prefix cache retains for a freshly prefilled chunk)."""
+        return [int(p) for p in self.tables[slot, start_page:start_page + n]]
+
+    # ------------------------------------------------------------- accounting
+    def kv_bytes(self) -> int:
+        """Device HBM held by the page arrays (the whole pool, null included)."""
+        return int(self.pages_k.nbytes) + int(self.pages_v.nbytes)
+
+    def publish_gauges(self) -> None:
+        self._in_use_gauge.set(self.allocator.used_count)
+        self._free_gauge.set(self.allocator.free_count)
+        self._shared_gauge.set(
+            self.allocator.shared_extra_refs() * self.page_kv_bytes
+        )
+
+
+__all__ = ["NULL_PAGE", "PageAllocator", "PagedKVPool"]

@@ -54,6 +54,38 @@ import jax.numpy as jnp
 from ..models.generation import sample_tokens_batched
 from ..models.transformer import KVCache, Transformer
 from ..utils.jax_compat import jit_cache_size
+from .paging import NULL_PAGE
+
+
+def _decode_scan(model: Transformer, window: int, params, cache, tokens, active,
+                 eos, do_sample, temperature, top_k, top_p, pad, rngs):
+    """The masked decode scan shared by the slab and paged decode windows —
+    one traced program, so the paged path cannot drift from the legacy
+    numerics.  Returns ``(cache, out_tokens [N, window], pending, rngs)``."""
+
+    def step(carry, _):
+        cache, tok, done, rngs = carry
+        prev_index = cache.index
+        logits, cache = model.apply({"params": params}, tok[:, None], cache=cache)
+        # model.apply advanced every lane; frozen lanes roll back
+        cache = cache.replace(
+            index=jnp.where(done, prev_index, prev_index + 1)
+        )
+        split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+        nxt = sample_tokens_batched(
+            logits[:, -1], split[:, 0],
+            do_sample=do_sample, temperature=temperature,
+            top_k=top_k, top_p=top_p,
+        )
+        nxt = jnp.where(done, pad, nxt)
+        done = done | ((eos >= 0) & (nxt == eos))
+        return (cache, nxt, done, split[:, 1]), nxt
+
+    done0 = ~active
+    (cache, tok, _, rngs), toks = jax.lax.scan(
+        step, (cache, tokens, done0, rngs), None, length=window
+    )
+    return cache, toks.T, tok, rngs
 
 
 def make_decode_window(model: Transformer, window: int):
@@ -78,29 +110,8 @@ def make_decode_window(model: Transformer, window: int):
     @functools.partial(jax.jit, donate_argnums=(1,))
     def decode_window(params, cache, tokens, active, eos, do_sample, temperature,
                       top_k, top_p, pad, rngs):
-        def step(carry, _):
-            cache, tok, done, rngs = carry
-            prev_index = cache.index
-            logits, cache = model.apply({"params": params}, tok[:, None], cache=cache)
-            # model.apply advanced every lane; frozen lanes roll back
-            cache = cache.replace(
-                index=jnp.where(done, prev_index, prev_index + 1)
-            )
-            split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
-            nxt = sample_tokens_batched(
-                logits[:, -1], split[:, 0],
-                do_sample=do_sample, temperature=temperature,
-                top_k=top_k, top_p=top_p,
-            )
-            nxt = jnp.where(done, pad, nxt)
-            done = done | ((eos >= 0) & (nxt == eos))
-            return (cache, nxt, done, split[:, 1]), nxt
-
-        done0 = ~active
-        (cache, tok, _, rngs), toks = jax.lax.scan(
-            step, (cache, tokens, done0, rngs), None, length=window
-        )
-        return cache, toks.T, tok, rngs
+        return _decode_scan(model, window, params, cache, tokens, active, eos,
+                            do_sample, temperature, top_k, top_p, pad, rngs)
 
     return decode_window
 
@@ -140,77 +151,85 @@ def make_verify_window(model: Transformer, k: int):
     is unreachable and gets overwritten by subsequent decode.  Frozen lanes
     (``~active``) commit nothing and keep their index.
     """
-    from ..models.generation import filter_logits_batched
-
-    kp1 = k + 1
-
     @functools.partial(jax.jit, donate_argnums=(1,))
     def verify_window(params, cache, tokens, active, eos, do_sample,
                       temperature, top_k, top_p, pad, rngs):
-        n = tokens.shape[0]
-        prev_index = cache.index
-        logits, cache = model.apply({"params": params}, tokens, cache=cache)
-        logits = logits.astype(jnp.float32)                  # [N, K+1, V]
-        vocab = logits.shape[-1]
-        drafts = tokens[:, 1:]                               # [N, K]
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        use_sample = do_sample & (temperature > 0.0)
-        split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
-        draw_rngs, new_rngs = split[:, 0], split[:, 1]
-
-        def _greedy(_):
-            return greedy, greedy[:, :k] == drafts
-
-        def _sampled(_):
-            rep = lambda x: jnp.repeat(x, kp1, axis=0)
-            filt = filter_logits_batched(
-                logits.reshape(n * kp1, vocab),
-                temperature=rep(temperature), top_k=rep(top_k), top_p=rep(top_p),
-            ).reshape(n, kp1, vocab)
-            probs = jax.nn.softmax(filt, axis=-1)
-            # per lane: K accept draws + K residual resamples + 1 bonus draw
-            keys = jax.vmap(lambda r: jax.random.split(r, 2 * k + 1))(draw_rngs)
-            u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(keys[:, :k])
-            p_draft = jnp.take_along_axis(
-                probs[:, :k], drafts[..., None], axis=-1
-            )[..., 0]
-            accepted = u < p_draft                           # [N, K]
-            neg_inf = jnp.finfo(jnp.float32).min
-            residual = jnp.where(                            # p with the draft removed
-                jax.nn.one_hot(drafts, vocab, dtype=bool), neg_inf, filt[:, :k]
-            )
-            res = jax.vmap(jax.vmap(jax.random.categorical))(
-                keys[:, k:2 * k], residual
-            ).astype(jnp.int32)
-            bonus = jax.vmap(jax.random.categorical)(
-                keys[:, 2 * k], filt[:, k]
-            ).astype(jnp.int32)
-            emit = jnp.concatenate(
-                [jnp.where(accepted, drafts, res), bonus[:, None]], axis=1
-            )
-            emit = jnp.where(use_sample[:, None], emit, greedy)
-            acc = jnp.where(use_sample[:, None], accepted, greedy[:, :k] == drafts)
-            return emit, acc
-
-        # all-greedy pools (the common serving mix) skip the full-vocab
-        # filtering/sampling machinery at runtime, mirroring sample_tokens_batched
-        emit, acc = jax.lax.cond(jnp.any(use_sample), _sampled, _greedy, None)
-        n_accept = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
-        pos = jnp.arange(kp1)[None, :]
-        committable = pos <= n_accept[:, None]
-        is_eos = (emit == eos[:, None]) & (eos >= 0)[:, None]
-        eos_before = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos) > 0
-        commit = committable & ~eos_before & active[:, None]
-        n_commit = commit.sum(axis=1).astype(jnp.int32)
-        out = jnp.where(commit, emit, pad[:, None])
-        # model.apply advanced every lane by K+1; roll back past rejections
-        # (and fully, for frozen lanes — their garbage writes are unreachable)
-        cache = cache.replace(index=prev_index + n_commit)
-        last = jnp.maximum(n_commit - 1, 0)
-        new_pending = jnp.take_along_axis(out, last[:, None], axis=1)[:, 0]
-        return cache, out, n_commit, new_pending, new_rngs
+        return _verify_body(model, k, params, cache, tokens, active, eos,
+                            do_sample, temperature, top_k, top_p, pad, rngs)
 
     return verify_window
+
+
+def _verify_body(model: Transformer, k: int, params, cache, tokens, active, eos,
+                 do_sample, temperature, top_k, top_p, pad, rngs):
+    """Forward + accept/commit of one speculative verify pass — shared by the
+    slab and paged verify windows (one traced program, no numeric drift)."""
+    from ..models.generation import filter_logits_batched
+
+    kp1 = k + 1
+    n = tokens.shape[0]
+    prev_index = cache.index
+    logits, cache = model.apply({"params": params}, tokens, cache=cache)
+    logits = logits.astype(jnp.float32)                  # [N, K+1, V]
+    vocab = logits.shape[-1]
+    drafts = tokens[:, 1:]                               # [N, K]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    use_sample = do_sample & (temperature > 0.0)
+    split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+    draw_rngs, new_rngs = split[:, 0], split[:, 1]
+
+    def _greedy(_):
+        return greedy, greedy[:, :k] == drafts
+
+    def _sampled(_):
+        rep = lambda x: jnp.repeat(x, kp1, axis=0)
+        filt = filter_logits_batched(
+            logits.reshape(n * kp1, vocab),
+            temperature=rep(temperature), top_k=rep(top_k), top_p=rep(top_p),
+        ).reshape(n, kp1, vocab)
+        probs = jax.nn.softmax(filt, axis=-1)
+        # per lane: K accept draws + K residual resamples + 1 bonus draw
+        keys = jax.vmap(lambda r: jax.random.split(r, 2 * k + 1))(draw_rngs)
+        u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(keys[:, :k])
+        p_draft = jnp.take_along_axis(
+            probs[:, :k], drafts[..., None], axis=-1
+        )[..., 0]
+        accepted = u < p_draft                           # [N, K]
+        neg_inf = jnp.finfo(jnp.float32).min
+        residual = jnp.where(                            # p with the draft removed
+            jax.nn.one_hot(drafts, vocab, dtype=bool), neg_inf, filt[:, :k]
+        )
+        res = jax.vmap(jax.vmap(jax.random.categorical))(
+            keys[:, k:2 * k], residual
+        ).astype(jnp.int32)
+        bonus = jax.vmap(jax.random.categorical)(
+            keys[:, 2 * k], filt[:, k]
+        ).astype(jnp.int32)
+        emit = jnp.concatenate(
+            [jnp.where(accepted, drafts, res), bonus[:, None]], axis=1
+        )
+        emit = jnp.where(use_sample[:, None], emit, greedy)
+        acc = jnp.where(use_sample[:, None], accepted, greedy[:, :k] == drafts)
+        return emit, acc
+
+    # all-greedy pools (the common serving mix) skip the full-vocab
+    # filtering/sampling machinery at runtime, mirroring sample_tokens_batched
+    emit, acc = jax.lax.cond(jnp.any(use_sample), _sampled, _greedy, None)
+    n_accept = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+    pos = jnp.arange(kp1)[None, :]
+    committable = pos <= n_accept[:, None]
+    is_eos = (emit == eos[:, None]) & (eos >= 0)[:, None]
+    eos_before = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos) > 0
+    commit = committable & ~eos_before & active[:, None]
+    n_commit = commit.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(commit, emit, pad[:, None])
+    # model.apply advanced every lane by K+1; roll back past rejections
+    # (and fully, for frozen lanes — their garbage writes are unreachable)
+    cache = cache.replace(index=prev_index + n_commit)
+    last = jnp.maximum(n_commit - 1, 0)
+    new_pending = jnp.take_along_axis(out, last[:, None], axis=1)[:, 0]
+    return cache, out, n_commit, new_pending, new_rngs
+
 
 
 def make_prefill_chunk(model: Transformer, chunk_len: int):
@@ -281,6 +300,166 @@ def make_copy_chunk(chunk_len: int):
         return scratch.replace(k=k, v=v, index=scratch.index + chunk_len)
 
     return copy_chunk
+
+
+# --------------------------------------------------------------------- paged
+# Block-table variants (ServingEngine(paged=True), :mod:`.paging`): KV lives
+# in a shared page pool ``[L, num_pages, page, Hkv, Dh]`` and each executable
+# gathers a lane's pages into a contiguous view, runs the *same* traced
+# decode/verify/prefill body as the slab path, then scatters only the
+# newly-written positions back.  The view width equals the slab width
+# (``pages_per_lane * page == max_len``), so the attention program — and with
+# it every greedy argmax — is bitwise identical to the legacy pool.  The
+# transient gathered view costs one slab-sized temporary per call; removing it
+# is exactly the ROADMAP's "Pallas paged decode kernel" item, which reads
+# pages in place.  Compiled-shape budget: one paged executable per legacy
+# shape plus ONE ``copy_page`` (copy-on-write), still bounded by bucket count.
+
+
+def _gather_view(pages, tables):
+    """``pages [L, NP, page, H, D]`` gathered through ``tables [N, P]`` into a
+    contiguous per-lane view ``[L, N, P * page, H, D]``."""
+    L, _, page, H, D = pages.shape
+    N, P = tables.shape
+    return pages[:, tables].reshape(L, N, P * page, H, D)
+
+
+def _scatter_span(pages, view, tables, start, width: int, active):
+    """Write ``view[:, n, start[n] : start[n] + width]`` back through lane
+    ``n``'s block table, for every ACTIVE lane.  Positions are guaranteed
+    in-range by the engine's admission check (``prompt + max_new + span <=
+    max_len``).  Inactive lanes' writes are rerouted to the null page: a
+    frozen lane's row may be vacant (all-null already), but a lane mid-prefill
+    has REAL pages mapped — possibly shared with the prefix cache — and its
+    stale write index must never trample them."""
+    L, _, page, H, D = pages.shape
+    N = tables.shape[0]
+    written = jax.vmap(
+        lambda kv, i: jax.lax.dynamic_slice(kv, (0, i, 0, 0), (L, width, H, D)),
+        in_axes=(1, 0), out_axes=1,
+    )(view, start)                                       # [L, N, width, H, D]
+    pos = start[:, None] + jnp.arange(width)             # [N, width]
+    pid = jnp.take_along_axis(tables, pos // page, axis=1)
+    pid = jnp.where(active[:, None], pid, NULL_PAGE)
+    off = pos % page
+    return pages.at[:, pid.reshape(-1), off.reshape(-1)].set(
+        written.reshape(L, N * width, H, D)
+    )
+
+
+def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int):
+    """Paged prefill: ``(params, tokens [1, chunk_len], pages_k, pages_v,
+    table [P], base) -> (pages_k, pages_v)``.
+
+    Gathers the prefilling lane's full view (shared prefix pages included —
+    this is how a partial cache hit feeds context to the chunks after it
+    without any copy), runs the slab prefill forward at scalar index ``base``,
+    and scatters the chunk's ``chunk_len / page_size`` freshly-written pages
+    back.  ``base`` and the chunk span are page-aligned by construction: every
+    bucket is a multiple of ``page_size`` and chunk starts are sums of
+    buckets, so a chunk never writes into a shared page.
+    """
+    if chunk_len % page_size != 0:
+        raise ValueError(
+            f"chunk bucket {chunk_len} must be a multiple of page_size {page_size}"
+        )
+    npg = chunk_len // page_size
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def paged_prefill_chunk(params, tokens, pages_k, pages_v, table, base):
+        L, _, page, H, D = pages_k.shape
+        cache = KVCache(
+            k=_gather_view(pages_k, table[None]),
+            v=_gather_view(pages_v, table[None]),
+            index=base,
+        )
+        _, cache = model.apply({"params": params}, tokens, cache=cache)
+        ids = jax.lax.dynamic_slice(table, (base // page_size,), (npg,))
+        wk = jax.lax.dynamic_slice(cache.k, (0, 0, base, 0, 0), (L, 1, chunk_len, H, D))
+        wv = jax.lax.dynamic_slice(cache.v, (0, 0, base, 0, 0), (L, 1, chunk_len, H, D))
+        pages_k = pages_k.at[:, ids].set(wk.reshape(L, npg, page, H, D))
+        pages_v = pages_v.at[:, ids].set(wv.reshape(L, npg, page, H, D))
+        return pages_k, pages_v
+
+    return paged_prefill_chunk
+
+
+def make_paged_decode_window(model: Transformer, window: int):
+    """Paged decode: ``(params, pages_k, pages_v, tables [N, P], index [N],
+    tokens, active, eos, do_sample, temperature, top_k, top_p, pad, rngs)
+    -> (pages_k, pages_v, out_tokens [N, window], new_pending, new_rngs)``.
+
+    Gather view -> the shared :func:`_decode_scan` (bitwise the slab program)
+    -> scatter the ``window`` written positions per lane.  The engine tracks
+    each lane's index on the host (install/advance arithmetic is exact), so
+    no index array needs to round-trip.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def paged_decode_window(params, pages_k, pages_v, tables, index, tokens,
+                            active, eos, do_sample, temperature, top_k, top_p,
+                            pad, rngs):
+        cache = KVCache(
+            k=_gather_view(pages_k, tables),
+            v=_gather_view(pages_v, tables),
+            index=index,
+        )
+        cache, toks, tok, rngs = _decode_scan(
+            model, window, params, cache, tokens, active, eos, do_sample,
+            temperature, top_k, top_p, pad, rngs,
+        )
+        pages_k = _scatter_span(pages_k, cache.k, tables, index, window, active)
+        pages_v = _scatter_span(pages_v, cache.v, tables, index, window, active)
+        return pages_k, pages_v, toks, tok, rngs
+
+    return paged_decode_window
+
+
+def make_paged_verify_window(model: Transformer, k: int):
+    """Paged speculative verify: the slab :func:`_verify_body` over a gathered
+    view, scattering all ``K+1`` written positions back (rejected positions'
+    KV is unreachable past the committed index and gets overwritten later,
+    exactly as in the slab path).  ``(params, pages_k, pages_v, tables, index,
+    tokens [N, K+1], ...) -> (pages_k, pages_v, out, n_commit, new_pending,
+    new_rngs)`` — the engine advances its host index mirror by ``n_commit``.
+    """
+    kp1 = k + 1
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def paged_verify_window(params, pages_k, pages_v, tables, index, tokens,
+                            active, eos, do_sample, temperature, top_k, top_p,
+                            pad, rngs):
+        cache = KVCache(
+            k=_gather_view(pages_k, tables),
+            v=_gather_view(pages_v, tables),
+            index=index,
+        )
+        cache, out, n_commit, new_pending, new_rngs = _verify_body(
+            model, k, params, cache, tokens, active, eos, do_sample,
+            temperature, top_k, top_p, pad, rngs,
+        )
+        pages_k = _scatter_span(pages_k, cache.k, tables, index, kp1, active)
+        pages_v = _scatter_span(pages_v, cache.v, tables, index, kp1, active)
+        return pages_k, pages_v, out, n_commit, new_pending, new_rngs
+
+    return paged_verify_window
+
+
+def make_copy_page():
+    """Jitted copy-on-write: ``(pages_k, pages_v, src, dst) -> (pages_k,
+    pages_v)`` duplicates one physical page.  Runs only when a lane's first
+    decode write lands in a page the prefix cache (or a sibling lane) still
+    references — at most once per admitted request, and never on the pure
+    aliasing hit path.  One compiled shape per engine, page-size-static.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def copy_page(pages_k, pages_v, src, dst):
+        pages_k = pages_k.at[:, dst].set(pages_k[:, src])
+        pages_v = pages_v.at[:, dst].set(pages_v[:, src])
+        return pages_k, pages_v
+
+    return copy_page
 
 
 def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int], ...]:

@@ -58,19 +58,27 @@ def rolling_hash(prev: int, tokens) -> int:
 
 
 class PrefixNode:
-    """One cached chunk: token ids + the retained device KV slab."""
+    """One cached chunk: token ids + the retained KV — either a device slab
+    (``k``/``v``, the slot-pool engine) or physical page ids into the shared
+    page pool (``pages``, the paged engine; see :mod:`.paging`).  A page node
+    holds one allocator reference per page for as long as it is resident."""
 
-    __slots__ = ("key", "tokens", "parent", "children", "k", "v", "nbytes",
-                 "refs", "last_used")
+    __slots__ = ("key", "tokens", "parent", "children", "k", "v", "pages",
+                 "nbytes", "refs", "last_used")
 
-    def __init__(self, key: int, tokens: Optional[np.ndarray], parent, k, v):
+    def __init__(self, key: int, tokens: Optional[np.ndarray], parent, k, v,
+                 pages: Optional[Tuple[int, ...]] = None, nbytes: Optional[int] = None):
         self.key = key
         self.tokens = tokens                 # [chunk] int32; None for the root
         self.parent = parent
         self.children: Dict[int, "PrefixNode"] = {}
         self.k = k                           # [L, 1, chunk, H, D] device slab
         self.v = v
-        self.nbytes = (int(k.nbytes) + int(v.nbytes)) if k is not None else 0
+        self.pages = pages                   # physical page ids (paged mode)
+        if nbytes is not None:
+            self.nbytes = int(nbytes)
+        else:
+            self.nbytes = (int(k.nbytes) + int(v.nbytes)) if k is not None else 0
         self.refs = 0
         self.last_used = 0
 
@@ -90,10 +98,16 @@ class PrefixCache:
         budget; eviction restores it as soon as pins release.
     registry: metrics registry for the ``serve/prefix_cache_*`` gauges and the
         eviction counter (default: the process registry).
+    on_evict: called with each node as it leaves the cache — the paged engine
+        uses this to drop the allocator references its page nodes hold (the
+        pages themselves survive while lanes still alias them; refcounting,
+        not residency in this tree, decides when HBM is reclaimed).
     """
 
     def __init__(self, capacity_bytes: int,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 on_evict=None):
+        self.on_evict = on_evict
         self.capacity = int(capacity_bytes)
         if self.capacity <= 0:
             raise ValueError(f"capacity_bytes must be positive, got {capacity_bytes}")
@@ -189,6 +203,53 @@ class PrefixCache:
         self._nodes_gauge.set(len(self._nodes))
         return node
 
+    def insert_pages(self, parent: Optional[PrefixNode], tokens,
+                     page_ids: Sequence[int], nbytes: int
+                     ) -> Optional[PrefixNode]:
+        """Retain one freshly prefilled chunk as *page references* (the paged
+        engine: zero copies — the lane's own pages are aliased, the caller
+        takes one allocator ref per page iff a NEW node was created, which it
+        detects by ``node.pages == tuple(page_ids)``).
+
+        Same contract as :meth:`insert`: returns the resident node (the
+        existing one on an exact re-insert — whose ``pages`` will differ from
+        ``page_ids``), or ``None`` when the chunk cannot be retained.
+        """
+        parent = parent if parent is not None else self.root
+        tokens = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        key = rolling_hash(parent.key, tokens)
+        existing = parent.children.get(key)
+        if existing is not None:
+            if np.array_equal(existing.tokens, tokens):
+                self._touch(existing)
+                return existing
+            return None  # 61-bit hash collision: keep the resident entry
+        if not self._make_room(int(nbytes)):
+            return None
+        node = PrefixNode(key, tokens, parent, None, None,
+                          pages=tuple(int(p) for p in page_ids), nbytes=nbytes)
+        self._touch(node)
+        parent.children[key] = node
+        self._nodes.append(node)
+        self.bytes += node.nbytes
+        self._bytes_gauge.set(self.bytes)
+        self._nodes_gauge.set(len(self._nodes))
+        return node
+
+    def evict_one(self) -> bool:
+        """Force one LRU unpinned-leaf eviction (page-pressure reclaim in the
+        paged engine).  Returns False when nothing is evictable."""
+        victim = None
+        for n in self._nodes:
+            if n.children or n.refs > 0:
+                continue
+            if victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        self._remove(victim)
+        return True
+
     def _make_room(self, nbytes: int) -> bool:
         """Evict LRU unpinned leaves until ``nbytes`` more fits; False if the
         survivors (pinned or interior) can't shrink far enough."""
@@ -214,6 +275,8 @@ class PrefixCache:
         self._evict_counter.inc()
         self._bytes_gauge.set(self.bytes)
         self._nodes_gauge.set(len(self._nodes))
+        if self.on_evict is not None:
+            self.on_evict(node)
 
     # ----------------------------------------------------------------- stats
     @property

@@ -88,6 +88,18 @@ class Request:
         """Prompt + generated tokens (the ``generate`` row, pad tail trimmed)."""
         return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
 
+    @property
+    def prefill_tokens(self) -> np.ndarray:
+        """What prefill must process for this request *now*: the prompt, plus
+        — after a preemption — every token already generated and streamed.
+        Replay re-prefills the whole effective prompt (ideally via prefix-cache
+        hits on the chunks this request populated in its first life) and
+        generation resumes exactly where it stopped; ``tokens`` is never
+        re-emitted.  Identical to ``prompt`` for a never-preempted request."""
+        if not self.tokens:
+            return self.prompt
+        return self.output_ids
+
     def emit(self, token: int) -> None:
         self.tokens.append(int(token))
         if self.on_token is not None:
@@ -135,7 +147,7 @@ class Scheduler:
         during the re-walk, so the fresh match can only be equal or longer."""
         if self.prefix_cache is None or not request.cache_prefix:
             return
-        nodes = self.prefix_cache.match(request.prompt, request.chunks)
+        nodes = self.prefix_cache.match(request.prefill_tokens, request.chunks)
         self.prefix_cache.acquire(nodes)
         if request.cache_nodes:
             self.prefix_cache.release(request.cache_nodes)
@@ -143,7 +155,7 @@ class Scheduler:
         request.cached_chunks = len(nodes)
 
     def submit(self, request: Request) -> None:
-        request.chunks = plan_chunks(len(request.prompt), self.buckets)
+        request.chunks = plan_chunks(len(request.prefill_tokens), self.buckets)
         self._match_prefix(request)
         self.queue.append(request)
         self.recorder.record(
@@ -151,6 +163,43 @@ class Scheduler:
             chunks=len(request.chunks), cached_chunks=request.cached_chunks,
             queue_depth=len(self.queue),
         )
+
+    def requeue(self, request: Request) -> None:
+        """Put a preempted RUNNING request back at the FRONT of the queue for
+        replay (it already waited its FCFS turn once).  Its effective prompt
+        is ``prefill_tokens`` — original prompt plus everything generated —
+        re-planned into chunks and re-matched against the prefix cache, so
+        replay aliases/reuses whatever this request populated in its first
+        life instead of recomputing it."""
+        request.state = RequestState.QUEUED
+        request.slot = None
+        request.chunks = plan_chunks(len(request.prefill_tokens), self.buckets)
+        request.next_chunk = 0
+        request.cached_chunks = 0
+        request.cache_chain_broken = False
+        self._match_prefix(request)
+        self.queue.appendleft(request)
+        self.recorder.record(
+            "serve/requeue", rid=request.rid,
+            effective_len=len(request.prefill_tokens),
+            cached_chunks=request.cached_chunks, queue_depth=len(self.queue),
+        )
+
+    def drop_cache_pins(self) -> int:
+        """Release every *queued* request's prefix-cache pins (the paged
+        engine's last-resort page reclaim: pinned nodes block eviction, and a
+        queued request can always re-match at admission).  Returns how many
+        requests were unpinned."""
+        dropped = 0
+        if self.prefix_cache is None:
+            return 0
+        for req in self.queue:
+            if req.cache_nodes:
+                self.prefix_cache.release(req.cache_nodes)
+                req.cache_nodes = []
+                req.cached_chunks = 0
+                dropped += 1
+        return dropped
 
     def cancel(self, rid: int) -> Optional[Request]:
         """Drop a still-QUEUED request (not yet prefilling) from the queue.

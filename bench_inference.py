@@ -288,6 +288,134 @@ def _spec_bench(args, model, cfg, params, preset):
     }
 
 
+def _paged_ab_bench(args, model, cfg, params, preset):
+    """Paged KV allocator vs legacy slab pool at the SAME KV HBM budget.
+
+    The workload is heavy-tailed chat traffic: every 8th request carries a
+    long prompt (0.75-1x the longest admissible), the rest are short turns.
+    The legacy arm reserves a full ``max_len`` slab per lane, so its KV
+    budget — ``(slots + 1)`` slabs counting the prefill scratch — admits only
+    a couple of lanes.  The paged arm gets a page pool of EXACTLY the same
+    byte size (asserted via ``kv_pool_bytes``) but allocates per page, so
+    short requests stop paying for the tail's worst case.  The headline
+    metric is the ratio of peak concurrent lanes; outputs must be
+    token-identical between the arms or the bench exits nonzero.
+
+    Both arms run with ``max_prompt_len == max_len``: the paged prefill
+    gathers a full-width view, and bitwise-identical logits across the arms
+    require the legacy scratch to span that same width.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)
+    window = args.decode_window
+    mp = max(16, min(args.seq, cfg.max_seq_len) // 2)
+    page = max(4, mp // 4)
+    buckets = (page, 2 * page)
+    max_len = (min(cfg.max_seq_len, 2 * mp) // page) * page
+
+    r = np.random.default_rng(args.serve_seed)
+    n = args.requests
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(4, mp // 12)), 0.6, n)), 4, page - 1
+    ).astype(int)
+    long_idx = np.arange(0, n, 8)
+    prompt_lens[long_idx] = r.integers(3 * mp // 4, mp + 1, long_idx.size)
+    prompts = [
+        r.integers(1, cfg.vocab_size, (int(p),)).astype(np.int32)
+        for p in prompt_lens
+    ]
+    out_cap = max(window, (max_len - mp - window) // 2)
+    out_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(window, out_cap // 4)), 0.6, n)),
+        window, out_cap,
+    ).astype(int)
+    gens = [GenerationConfig(max_new_tokens=int(o)) for o in out_lens]
+    useful_tokens = int(out_lens.sum())
+
+    legacy_slots = 2
+    pages_per_lane = max_len // page
+    # equal KV HBM: legacy pays (slots + 1) full-width slabs (pool + prefill
+    # scratch); the paged pool gets exactly that many bytes worth of pages
+    # (one of which is the reserved null page — the paged arm absorbs that
+    # handicap rather than rounding the budget up)
+    num_pages = (legacy_slots + 1) * pages_per_lane
+
+    def run_arm(paged):
+        registry = MetricsRegistry()
+        kwargs = dict(
+            num_slots=args.batch if paged else legacy_slots,
+            max_len=max_len, max_prompt_len=max_len, prefill_buckets=buckets,
+            decode_window=window, registry=registry, prefix_cache_mb=0,
+        )
+        if paged:
+            kwargs.update(paged=True, page_size=page, num_pages=num_pages)
+        eng = ServingEngine(model, params, **kwargs)
+        warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets]
+        eng.serve(warm, GenerationConfig(max_new_tokens=window))
+        for k in eng.stats:
+            eng.stats[k] = 0
+        eng.peak_active_lanes = 0
+        registry.reset()
+        t0 = time.perf_counter()
+        reqs = eng.serve(prompts, gens)
+        dt = time.perf_counter() - t0
+        return eng, reqs, dt
+
+    eng_paged, reqs_paged, dt_paged = run_arm(True)
+    eng_slab, reqs_slab, dt_slab = run_arm(False)
+    if [q.tokens for q in reqs_paged] != [q.tokens for q in reqs_slab]:
+        raise SystemExit(
+            "paged KV allocator changed greedy outputs: paged-arm tokens "
+            "differ from the legacy slab arm on the same workload"
+        )
+    if eng_paged.kv_pool_bytes() != eng_slab.kv_pool_bytes():
+        raise SystemExit(
+            f"KV budgets diverged: paged arm holds {eng_paged.kv_pool_bytes()} "
+            f"bytes vs legacy {eng_slab.kv_pool_bytes()} — the A/B is only "
+            "meaningful at equal HBM"
+        )
+    peak_ratio = eng_paged.peak_active_lanes / max(1, eng_slab.peak_active_lanes)
+
+    def arm_detail(eng, reqs, dt):
+        return {
+            "num_slots": eng.num_slots,
+            "peak_active_lanes": eng.peak_active_lanes,
+            "kv_pool_bytes": eng.kv_pool_bytes(),
+            "wall_s": round(dt, 3),
+            "tokens_per_s": round(useful_tokens / dt, 2),
+            "preemptions": eng.stats.get("preemptions", 0),
+            "cow_copies": eng.stats.get("cow_copies", 0),
+            "compiled_executables": eng.compiled_executable_counts(),
+        }
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "decode_window": window,
+        "prefill_buckets": list(buckets),
+        "page_size": page,
+        "num_pages": num_pages,
+        "max_len": max_len,
+        "prompt_len_p50_max": [int(np.median(prompt_lens)), int(prompt_lens.max())],
+        "out_len_p50_max": [int(np.median(out_lens)), int(out_lens.max())],
+        "useful_tokens": useful_tokens,
+        "outputs_token_identical": True,
+        "paged": arm_detail(eng_paged, reqs_paged, dt_paged),
+        "legacy": arm_detail(eng_slab, reqs_slab, dt_slab),
+    }
+    return {
+        "metric": "serving_paged_peak_lanes_ratio",
+        "value": round(peak_ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(peak_ratio, 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -306,6 +434,12 @@ def _serve_bench(args, model, cfg, params, preset):
     requests), outputs are asserted token-identical between the two runs, and
     ``detail.prefix_hit_rate`` records the reuse the radix cache found.
     """
+    if getattr(args, "paged_ab", False):
+        if args.shared_prefix:
+            raise SystemExit("--paged-ab and --shared-prefix are separate "
+                             "serve workloads; pick one")
+        return _paged_ab_bench(args, model, cfg, params, preset)
+
     from accelerate_tpu.models.generation import GenerationConfig, generate
     from accelerate_tpu.serving import ServingEngine
     from accelerate_tpu.telemetry import MetricsRegistry
@@ -489,6 +623,10 @@ def main():
                         help="serve task: common system-prompt length shared by "
                              "every request (0 = off); benches the prefix KV "
                              "cache against a cache-off run of the same workload")
+    parser.add_argument("--paged-ab", dest="paged_ab", action="store_true",
+                        help="--task serve: A/B the paged KV allocator against "
+                             "the legacy slab pool at the same KV HBM budget "
+                             "on a heavy-tail workload (token-identical check)")
     parser.add_argument("--prefix-cache-mb", dest="prefix_cache_mb", type=float,
                         default=64.0,
                         help="serve task: prefix KV cache byte budget (MiB) for "

@@ -1,0 +1,326 @@
+"""Paged KV allocator: refcounting, sharing, copy-on-write, preemption.
+
+The paged engine's contract extends the serving engine's: block-table
+indirection is *invisible* in the outputs.  Greedy decode through the page
+pool is token-identical to both the legacy slab pool and the static
+``generate`` path — the gathered per-lane view has exactly the slab's width,
+so the attention program is bitwise the same — while prefix-cache hits alias
+physical pages with zero KV copies, shared pages survive eviction pressure
+for as long as anything references them, and page pressure preempts the
+youngest lane instead of corrupting anyone's KV.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models.generation import GenerationConfig, generate
+from accelerate_tpu.models.transformer import Transformer, TransformerConfig
+from accelerate_tpu.serving import NULL_PAGE, PageAllocator, PagedKVPool, ServingEngine
+from accelerate_tpu.telemetry import MetricsRegistry
+from accelerate_tpu.utils.jax_compat import jit_cache_supported
+
+
+def _tiny_model(seed=0, **kw):
+    cfg = TransformerConfig.tiny(
+        dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=64, **kw
+    )
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _expected(model, params, prompt, gen):
+    seqs, _ = generate(model, params, jnp.asarray(prompt, jnp.int32)[None], gen)
+    out = np.asarray(seqs[0])[len(prompt):]
+    if gen.eos_token_id is not None:
+        hits = np.nonzero(out == gen.eos_token_id)[0]
+        if hits.size:
+            out = out[: hits[0] + 1]
+    return out.tolist()
+
+
+def _engine(model, params, **kw):
+    defaults = dict(num_slots=2, max_len=64, prefill_buckets=(4, 8),
+                    prefill_token_budget=8, decode_window=2)
+    defaults.update(kw)
+    return ServingEngine(model, params, **defaults)
+
+
+class TestPageAllocator:
+    def test_alloc_is_all_or_nothing_and_deterministic(self):
+        a = PageAllocator(6)  # 5 real pages
+        assert a.free_count == 5 and a.used_count == 0
+        assert a.alloc(3) == [1, 2, 3]  # ascending: allocation order is stable
+        assert a.alloc(3) is None       # only 2 left: nothing taken
+        assert a.free_count == 2
+        assert a.alloc(2) == [4, 5]
+        assert a.alloc(0) == []
+
+    def test_refcount_lifecycle(self):
+        a = PageAllocator(4)
+        ids = a.alloc(2)
+        a.ref(ids)                       # a second owner
+        assert a.deref(ids) == 0         # first deref frees nothing
+        assert a.deref(ids) == 2         # second returns both to the free list
+        assert a.free_count == 3
+        with pytest.raises(RuntimeError):
+            a.deref(ids)                 # underflow is a hard bug, not a no-op
+        with pytest.raises(RuntimeError):
+            a.ref([ids[0]])              # ref on a free page likewise
+
+    def test_null_page_is_reserved(self):
+        a = PageAllocator(3)
+        assert NULL_PAGE not in a.alloc(2)
+        assert a.deref([NULL_PAGE]) == 0  # deref of the sink is a no-op
+        assert a.refs[NULL_PAGE] == 1
+
+    def test_shared_extra_refs_counts_aliases_only(self):
+        a = PageAllocator(5)
+        ids = a.alloc(2)
+        assert a.shared_extra_refs() == 0
+        a.ref(ids)
+        a.ref([ids[0]])
+        assert a.shared_extra_refs() == 3  # (3-1) + (2-1)
+
+
+class TestPagedKVPool:
+    def test_geometry_validation(self):
+        cfg = TransformerConfig.tiny(max_seq_len=64)
+        with pytest.raises(ValueError):  # view width must equal slab width
+            PagedKVPool(cfg, 2, max_len=10, page_size=4, num_pages=8,
+                        registry=MetricsRegistry())
+        with pytest.raises(ValueError):  # one full lane must always fit
+            PagedKVPool(cfg, 2, max_len=16, page_size=4, num_pages=4,
+                        registry=MetricsRegistry())
+
+    def test_lane_table_ops(self):
+        cfg = TransformerConfig.tiny(max_seq_len=64)
+        pool = PagedKVPool(cfg, 2, max_len=16, page_size=4, num_pages=9,
+                           registry=MetricsRegistry())
+        ids = pool.allocator.alloc(2)
+        pool.lane_append_owned(0, ids)
+        pool.lane_append_shared(1, ids)  # lane 1 aliases: refs go to 2
+        assert pool.chunk_ids(0, 0, 2) == ids == pool.chunk_ids(1, 0, 2)
+        assert all(pool.allocator.refs[p] == 2 for p in ids)
+        new = pool.allocator.alloc(1)
+        old = pool.lane_replace(1, 0, new[0])  # lane 1 COWs its first page
+        assert old == ids[0] and pool.allocator.refs[old] == 1
+        assert pool.lane_release(1) == 1       # frees only the COW'd page
+        assert pool.lane_release(0) == 2
+        assert np.all(pool.tables == NULL_PAGE)
+        assert pool.allocator.used_count == 0
+
+
+class TestPagedTokenIdentity:
+    """The acceptance gate: greedy outputs are token-identical paged on/off."""
+
+    def _serve(self, model, params, prompts, gen, **kw):
+        eng = _engine(model, params, registry=MetricsRegistry(), **kw)
+        reqs = eng.serve([p.copy() for p in prompts], configs=gen)
+        return eng, [r.tokens for r in reqs]
+
+    def test_mixed_lengths_match_legacy_and_generate(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, model.config.vocab_size, (n,)).astype(np.int32)
+                   for n in (5, 9, 3, 12, 7, 16)]
+        gen = GenerationConfig(max_new_tokens=8, do_sample=False, eos_token_id=None)
+        _, legacy = self._serve(model, params, prompts, gen, paged=False)
+        eng, paged = self._serve(model, params, prompts, gen, paged=True)
+        assert paged == legacy
+        for toks, prompt in zip(paged, prompts):
+            assert toks == _expected(model, params, prompt, gen)
+        # every page came back once the pool drained and the cache let go
+        while eng.prefix_cache.evict_one():
+            pass
+        assert eng.kv.allocator.used_count == 0
+
+    def test_sampled_stream_matches_legacy(self):
+        # same base seed + same per-rid fold-in => the identical sample stream,
+        # paged or not (the traced decode body is shared, not just equivalent)
+        model, params = _tiny_model()
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(1, model.config.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 11, 9)]
+        gen = GenerationConfig(max_new_tokens=6, do_sample=True, temperature=0.8,
+                               top_k=50, eos_token_id=None)
+        _, legacy = self._serve(model, params, prompts, gen, paged=False)
+        _, paged = self._serve(model, params, prompts, gen, paged=True)
+        assert paged == legacy
+
+    def test_speculative_paged_matches_legacy(self):
+        model, params = _tiny_model()
+        base = np.tile(np.array([5, 6, 7], np.int32), 8)
+        prompts = [base[:9], base[:12], base[:9]]
+        gen = GenerationConfig(max_new_tokens=8, do_sample=False, eos_token_id=None)
+        _, legacy = self._serve(model, params, prompts, gen, paged=False, speculate_k=2)
+        eng, paged = self._serve(model, params, prompts, gen, paged=True, speculate_k=2)
+        assert paged == legacy
+        assert eng.stats["spec_accepted"] > 0  # the verify path actually ran
+
+    def test_compiled_shape_budget(self):
+        """Paged swaps insert + per-bucket copies for one copy_page: the whole
+        device program set is decode + per-bucket prefill + copy_page."""
+        if not jit_cache_supported():
+            pytest.skip("this jax hides the pjit executable-cache counter")
+        model, params = _tiny_model()
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, model.config.vocab_size, (n,)).astype(np.int32)
+                   for n in (5, 9, 12, 8)]
+        gen = GenerationConfig(max_new_tokens=4, do_sample=False, eos_token_id=None)
+        eng, _ = self._serve(model, params, prompts, gen, paged=True)
+        counts = eng.compiled_executable_counts()
+        assert set(counts) == {"decode_window", "copy_page", "prefill_4", "prefill_8"}
+        assert counts["decode_window"] == 1
+        assert counts["prefill_4"] == 1 and counts["prefill_8"] == 1
+        assert counts["copy_page"] <= 1  # compiles only on the first COW
+        assert not eng._decode.over_budget()
+
+
+class TestPagedPrefixSharing:
+    def test_partial_hit_is_zero_copy(self):
+        """A hit whose prompt extends past the shared prefix aliases pages
+        through the block table: no copy executable ever compiles."""
+        if not jit_cache_supported():
+            pytest.skip("this jax hides the pjit executable-cache counter")
+        model, params = _tiny_model()
+        rng = np.random.default_rng(10)
+        vocab = model.config.vocab_size
+        shared = rng.integers(1, vocab, (8,)).astype(np.int32)
+        prompts = [np.concatenate([shared, rng.integers(1, vocab, (5,)).astype(np.int32)])
+                   for _ in range(3)]
+        gen = GenerationConfig(max_new_tokens=5, do_sample=False, eos_token_id=None)
+        legacy = _engine(model, params, registry=MetricsRegistry())
+        expect = [r.tokens for r in legacy.serve([p.copy() for p in prompts], configs=gen)]
+        eng = _engine(model, params, paged=True, registry=MetricsRegistry())
+        reqs = eng.serve([p.copy() for p in prompts], configs=gen)
+        assert [r.tokens for r in reqs] == expect
+        assert eng.stats["prefix_hit_tokens"] > 0
+        assert eng.stats["cow_copies"] == 0
+        assert eng.compiled_executable_counts()["copy_page"] == 0
+
+    def test_cow_never_mutates_sibling_lanes(self):
+        """Two lanes fully aliasing the same cached prompt: each COWs the
+        shared tail page before writing, and both streams stay exact."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(11)
+        shared = rng.integers(1, model.config.vocab_size, (8,)).astype(np.int32)
+        gen = GenerationConfig(max_new_tokens=6, do_sample=False, eos_token_id=None)
+        expect = _expected(model, params, shared, gen)
+        eng = _engine(model, params, paged=True, registry=MetricsRegistry())
+        reqs = eng.serve([shared.copy(), shared.copy(), shared.copy()], configs=gen)
+        assert all(r.tokens == expect for r in reqs)
+        assert eng.stats["cow_copies"] >= 1
+
+    def test_shared_pages_survive_eviction_while_referenced(self):
+        """A cache squeezed far below the workload's footprint churns nodes
+        constantly; pages a running lane still aliases must outlive their
+        node's eviction (refcount, not tree residency, frees HBM)."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(12)
+        vocab = model.config.vocab_size
+        shared = rng.integers(1, vocab, (8,)).astype(np.int32)
+        prompts = [np.concatenate([shared, rng.integers(1, vocab, (n,)).astype(np.int32)])
+                   for n in (4, 6, 5, 7)]
+        gen = GenerationConfig(max_new_tokens=6, do_sample=False, eos_token_id=None)
+        legacy = _engine(model, params, registry=MetricsRegistry())
+        expect = [r.tokens for r in legacy.serve([p.copy() for p in prompts], configs=gen)]
+        # ~2.5 bucket-8 chunk-nodes of budget: inserts evict constantly
+        cfg = model.config
+        page_bytes = 2 * 4 * cfg.num_kv_heads * cfg.resolved_head_dim * cfg.num_layers * 4
+        eng = _engine(model, params, paged=True,
+                      prefix_cache_mb=2.5 * 2 * page_bytes / 2**20,
+                      registry=MetricsRegistry())
+        reqs = eng.serve([p.copy() for p in prompts], configs=gen)
+        assert [r.tokens for r in reqs] == expect
+        assert eng.prefix_cache.evictions > 0
+        # no page leaked: drain the cache and everything returns
+        while eng.prefix_cache.evict_one():
+            pass
+        assert eng.kv.allocator.used_count == 0
+
+    def test_cache_pages_freed_only_at_refcount_zero(self):
+        """Direct check of the eviction hook: a lane's alias keeps the page
+        allocated after the cache node is evicted; releasing the lane frees it."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(13)
+        prompt = rng.integers(1, model.config.vocab_size, (8,)).astype(np.int32)
+        gen = GenerationConfig(max_new_tokens=20, do_sample=False, eos_token_id=None)
+        eng = _engine(model, params, paged=True, registry=MetricsRegistry())
+        req = eng.submit(prompt, config=gen)
+        while not eng._active.any():
+            eng.step()
+        # the lane runs and the cache holds the prefix chunks it populated
+        cached_pages = [p for node in eng.prefix_cache._nodes for p in node.pages]
+        assert cached_pages
+        refs = eng.kv.allocator.refs
+        # the tail page was COW'd at install (decode writes position plen-1),
+        # leaving the cache its sole owner; earlier pages stay lane+cache shared
+        assert refs[cached_pages[0]] == 2
+        assert refs[cached_pages[-1]] == 1
+        while eng.prefix_cache.evict_one():
+            pass
+        assert refs[cached_pages[0]] == 1   # the lane's alias keeps it alive
+        assert refs[cached_pages[-1]] == 0  # cache-only page freed at zero
+        eng.run()
+        assert req.done
+        assert eng.kv.allocator.used_count == 0
+
+
+class TestPagedPressure:
+    def test_preemption_stays_token_exact(self):
+        """A pool barely over one lane's worth of pages forces preemption:
+        the youngest lane releases its pages, requeues, replays, and every
+        output stays identical to the slab engine's."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(14)
+        prompts = [rng.integers(1, model.config.vocab_size, (n,)).astype(np.int32)
+                   for n in (12, 16, 9, 14)]
+        gen = GenerationConfig(max_new_tokens=28, do_sample=False, eos_token_id=None)
+        legacy = _engine(model, params, prefix_cache_mb=None, registry=MetricsRegistry())
+        expect = [r.tokens for r in legacy.serve([p.copy() for p in prompts], configs=gen)]
+        eng = _engine(model, params, paged=True, prefix_cache_mb=None,
+                      num_pages=17, registry=MetricsRegistry())  # Pmax=16 + null
+        reqs = eng.serve([p.copy() for p in prompts], configs=gen)
+        assert [r.tokens for r in reqs] == expect
+        assert eng.stats["preemptions"] >= 1
+        assert eng.kv.allocator.used_count == 0
+
+    def test_cancel_running_lane_returns_pages(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(15)
+        p1, p2 = (rng.integers(1, model.config.vocab_size, (n,)).astype(np.int32)
+                  for n in (12, 16))
+        gen = GenerationConfig(max_new_tokens=16, do_sample=False, eos_token_id=None)
+        expect2 = _expected(model, params, p2, gen)
+        eng = _engine(model, params, paged=True, prefix_cache_mb=None,
+                      registry=MetricsRegistry())
+        r1 = eng.submit(p1, config=gen)
+        r2 = eng.submit(p2, config=gen)
+        while r1.state.value != "running":
+            eng.step()
+        free_before = eng.kv.allocator.free_count
+        assert eng.cancel(r1)
+        assert r1.state.value == "cancelled"
+        assert eng.kv.allocator.free_count > free_before  # pages back NOW
+        assert eng.stats["cancelled"] == 1
+        eng.run()
+        assert r2.tokens == expect2  # the surviving lane never noticed
+        assert eng.kv.allocator.used_count == 0
+
+    def test_gauges_published(self):
+        model, params = _tiny_model()
+        rng = np.random.default_rng(16)
+        prompt = rng.integers(1, model.config.vocab_size, (9,)).astype(np.int32)
+        reg = MetricsRegistry()
+        eng = _engine(model, params, paged=True, registry=reg)
+        eng.serve([prompt], configs=GenerationConfig(
+            max_new_tokens=4, do_sample=False, eos_token_id=None))
+        snap = reg.snapshot()
+        assert "serve/kv_pages_in_use" in snap
+        assert "serve/kv_pages_free" in snap
+        assert "serve/kv_bytes_shared" in snap
+        assert snap["serve/kv_pages_in_use"] + snap["serve/kv_pages_free"] \
+            == eng.kv.num_pages - 1

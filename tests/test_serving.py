@@ -472,6 +472,30 @@ class TestPrefixCacheEngine:
         }
         assert not any(wd.over_budget() for wd in eng._copy.values())
 
+    def test_compiled_shape_budget_paged(self):
+        """The paged engine's whole program set: one decode window, one
+        prefill per bucket, one copy_page — no insert, no per-bucket copies
+        (hits alias pages), and nothing retraces across a workload that mixes
+        cold prompts, duplicate-prefix hits, and copy-on-write."""
+        if not jit_cache_supported():
+            pytest.skip("this jax hides the pjit executable-cache counter")
+        model, params = _tiny_model()
+        rng = np.random.default_rng(123)
+        vocab = model.config.vocab_size
+        p8 = rng.integers(1, vocab, (8,)).astype(np.int32)
+        prompts = [p8, p8.copy(), np.concatenate([p8, p8[:5]]),
+                   rng.integers(1, vocab, (11,)).astype(np.int32)]
+        eng = _engine(model, params, paged=True)
+        gen = GenerationConfig(max_new_tokens=3)
+        reqs = eng.serve(prompts, [gen] * len(prompts))
+        for req, prompt in zip(reqs, prompts):
+            assert req.tokens == _expected(model, params, prompt, gen)
+        assert eng.compiled_executable_counts() == {
+            "decode_window": 1, "copy_page": 1, "prefill_4": 1, "prefill_8": 1,
+        }
+        assert not eng._decode.over_budget()
+        assert not eng._copy_page.over_budget()
+
     def test_eviction_under_tiny_engine_budget_stays_exact(self):
         """A budget far below the workload's slab footprint churns the cache
         hard (insert/evict on nearly every chunk) without touching outputs."""
