@@ -130,7 +130,12 @@ class ServingEngine:
         requeues for replay through the prefix cache.  Greedy outputs are
         token-identical paged on/off (the gathered view is exactly the slab
         shape, so the attention program is bitwise the same; keep
-        ``max_prompt_len == max_len``, the default, for strict identity).
+        ``max_prompt_len == max_len``, the default, for strict identity) and
+        greedy replay after preemption is token-exact; a preempted *sampled*
+        lane resumes on a restarted RNG stream (the lane RNG re-seeds from
+        the request id at install), so its continuation is
+        distribution-correct but not sample-exact — the same contract as
+        speculative decoding.
     page_size: tokens per KV page (paged mode).  Must divide every prefill
         bucket and ``max_len``; default ``gcd(prefill_buckets)`` — the prefix
         cache's chunk granularity.
@@ -647,7 +652,10 @@ class ServingEngine:
         requeue it at the FRONT for replay over prompt + generated tokens
         (ideally hitting the cache chunks it populated in its first life).
         Youngest-first keeps FCFS intact — the last admitted is the first
-        sacrificed.  Returns False with no replayable victim."""
+        sacrificed.  Greedy replay is token-exact; a sampled victim resumes
+        on a re-seeded RNG stream (``_install`` folds the base rng with the
+        rid again), so its continuation is distribution-correct but not
+        sample-exact.  Returns False with no replayable victim."""
         victims = sorted(
             (s for s in np.nonzero(self._active)[0] if self._slot_req[s] is not None),
             key=lambda s: self._slot_req[s].rid, reverse=True,

@@ -543,18 +543,21 @@ class TestCancel:
         assert eng.stats["cancelled"] == 1
         assert eng.stats["requests_completed"] == 2
 
-    def test_cancel_running_done_or_unknown_is_false(self):
+    def test_cancel_running_true_done_or_unknown_false(self):
         model, params = _tiny_model()
         rng = np.random.default_rng(27)
         eng = _engine(model, params)
-        req = eng.submit(_prompts(rng, [4], model.config.vocab_size)[0],
-                         max_new_tokens=3)
-        eng.step()                          # admitted: past the point of no return
-        assert not eng.cancel(req.rid)
+        prompts = _prompts(rng, [4, 4], model.config.vocab_size)
+        req = eng.submit(prompts[0], max_new_tokens=3)
+        eng.step()                          # admitted: lane is RUNNING
+        assert eng.cancel(req.rid)          # running lanes cancel mid-stream
+        assert req.state is RequestState.CANCELLED
+        assert eng.stats["cancelled"] == 1
+        other = eng.submit(prompts[1], max_new_tokens=3)
         eng.run()
-        assert req.done and not eng.cancel(req)
+        assert other.done and not eng.cancel(other)
         assert not eng.cancel(999)
-        assert eng.stats["cancelled"] == 0
+        assert eng.stats["cancelled"] == 1
 
     def test_cancel_releases_pinned_prefix_nodes(self):
         model, params = _tiny_model()
