@@ -50,6 +50,7 @@ bench-serve:
 	python bench_inference.py --task serve
 	python bench_inference.py --task serve --shared-prefix 16
 	python bench_inference.py --task serve --paged-ab
+	python bench_inference.py --task serve --kernel-ab
 	python bench_inference.py --task spec
 
 quality:
@@ -57,4 +58,5 @@ quality:
 	python tools/check_reference_citations.py
 	python tools/check_no_bare_print.py
 	python tools/check_no_method_lru_cache.py
+	python tools/check_pallas_interpret.py
 	python tools/check_metric_docs.py

@@ -178,6 +178,15 @@ class TransformerConfig:
     num_experts_per_tok: int = 2
     expert_capacity_factor: float = 2.0
     router_aux_loss_coef: float = 0.01
+    # Attention program for PagedKVCache forwards (the serving engine's
+    # in-model paged windows): "xla" is the live-masked-gather reference —
+    # bitwise identical to the contiguous slab; "pallas" the in-place paged
+    # decode kernel (ops/paged_attention.py).  Neither adds parameters, so
+    # one set of params serves Transformers differing only in these fields.
+    paged_kernel: str = "xla"
+    # pallas interpret-mode override for the paged kernel; None = auto
+    # (interpret off TPU — the CPU-testing discipline)
+    paged_interpret: Optional[bool] = None
 
     @property
     def resolved_head_dim(self) -> int:
@@ -217,6 +226,17 @@ class TransformerConfig:
             )
         if self.sliding_window is not None and self.sliding_window <= 0:
             raise ValueError(f"sliding_window must be positive, got {self.sliding_window}")
+        if self.paged_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"Unknown paged_kernel {self.paged_kernel!r}; choose 'xla' or 'pallas'"
+            )
+        if self.paged_kernel == "pallas" and (
+            self.sliding_window is not None or self.positional == "alibi"
+        ):
+            raise ValueError(
+                "paged_kernel='pallas' supports full-causal rope/learned models; "
+                "sliding_window and alibi need the 'xla' reference path"
+            )
 
     @classmethod
     def llama2_7b(cls, **kw):
@@ -295,6 +315,37 @@ class KVCache(struct.PyTreeNode):
     @property
     def max_len(self) -> int:
         return self.k.shape[2]
+
+
+class PagedKVCache(struct.PyTreeNode):
+    """Paged KV cache: the serving page pool threaded *through* the model.
+
+    Where :class:`KVCache` owns a contiguous per-lane slab, this carries the
+    shared refcounted page pool (``[L, num_pages, page, Hkv, D]``) plus each
+    lane's block table — attention reads pages in place
+    (:mod:`accelerate_tpu.ops.paged_attention`), selected by
+    ``TransformerConfig.paged_kernel``.  Scales are ALWAYS present (ones for
+    direct-store dtypes) so the pytree structure — and with it the compiled
+    window signature — does not fork on the KV dtype; quantized-ness is the
+    static page dtype.  ``active`` gates writes: frozen lanes' scatters are
+    rerouted to the null page exactly like the gather windows in
+    :mod:`accelerate_tpu.serving.pool`.  ``quant_err`` accumulates the max
+    abs KV round-trip error of values written this forward (0 when native) —
+    the engine surfaces it as ``serve/kv_quant_error``.
+    """
+
+    pages_k: jax.Array      # [L, num_pages, page, n_kv_heads, head_dim]
+    pages_v: jax.Array
+    k_scales: jax.Array     # [L, num_pages, n_kv_heads] f32 dequant scales
+    v_scales: jax.Array
+    tables: jax.Array       # [N, pages_per_lane] int32 block tables
+    index: jax.Array        # [N] int32 next write position per lane
+    active: jax.Array       # [N] bool write gate (frozen lanes -> null page)
+    quant_err: jax.Array    # f32 scalar, running max round-trip error
+
+    @property
+    def max_len(self) -> int:
+        return self.tables.shape[1] * self.pages_k.shape[2]
 
 
 def cached_attention(q, k, v, q_positions, window=None, alibi=False):
@@ -486,6 +537,49 @@ class Attention(nn.Module):
         if cfg.positional == "rope":
             q = _apply_rope(q, positions, cfg)
             k = _apply_rope(k, positions, cfg)
+        if cache is not None and len(cache) == 7:
+            # paged layer cache: (pages_k, pages_v, k_scales, v_scales,
+            # tables, index, active) — scatter the new KV through the block
+            # tables, then attend over pages in place.  ``index`` doubles as
+            # each lane's pre-write length (= first new position).
+            pages_k, pages_v, k_scales, v_scales, tables, index, active = cache
+            from ..ops.paged_attention import (
+                kv_qmax,
+                paged_attention,
+                paged_attention_reference,
+                paged_insert,
+                paged_quantized_insert,
+            )
+
+            if kv_qmax(pages_k.dtype) is not None:
+                pages_k, k_scales, err_k = paged_quantized_insert(
+                    pages_k, k_scales, k, tables, index, active
+                )
+                pages_v, v_scales, err_v = paged_quantized_insert(
+                    pages_v, v_scales, v, tables, index, active
+                )
+                err = jnp.maximum(err_k, err_v)
+                sk, sv = k_scales, v_scales
+            else:
+                pages_k = paged_insert(pages_k, k, tables, index, active)
+                pages_v = paged_insert(pages_v, v, tables, index, active)
+                err = jnp.float32(0.0)
+                sk = sv = None
+            if cfg.paged_kernel == "pallas":
+                out = paged_attention(
+                    q, pages_k, pages_v, tables, index,
+                    k_scales=sk, v_scales=sv, interpret=cfg.paged_interpret,
+                )
+            else:
+                out = paged_attention_reference(
+                    q, pages_k, pages_v, tables, index,
+                    k_scales=sk, v_scales=sv, window=cfg.sliding_window,
+                    alibi=cfg.positional == "alibi",
+                )
+            out = out.reshape(b, s, cfg.num_heads * hd)
+            return dense("o_proj", cfg.hidden_size)(out), (
+                pages_k, pages_v, k_scales, v_scales, err,
+            )
         if cache is not None:
             k_cache, v_cache, index = cache
             if jnp.ndim(index) == 0:
@@ -683,11 +777,24 @@ class Transformer(nn.Module):
                 length=cfg.num_layers,
                 in_axes=(nn.broadcast, nn.broadcast, 0),
             )
-            kv_in = (None, None) if cache is None else (cache.k, cache.v)
-            x, kv_out = ScanLayers(cfg, name="layers")(
-                x, positions, None if cache is None else cache.index, kv_in
-            )
-            if cache is not None:
+            if cache is None:
+                kv_in, bcast = (None, None), None
+            elif isinstance(cache, PagedKVCache):
+                # pool/scale arrays scan over depth; tables/index/active (and
+                # the lane write gate) broadcast to every layer
+                kv_in = (cache.pages_k, cache.pages_v, cache.k_scales, cache.v_scales)
+                bcast = (cache.tables, cache.index, cache.active)
+            else:
+                kv_in, bcast = (cache.k, cache.v), cache.index
+            x, kv_out = ScanLayers(cfg, name="layers")(x, positions, bcast, kv_in)
+            if isinstance(cache, PagedKVCache):
+                new_cache = cache.replace(
+                    pages_k=kv_out[0], pages_v=kv_out[1],
+                    k_scales=kv_out[2], v_scales=kv_out[3],
+                    index=cache.index + input_ids.shape[1],
+                    quant_err=jnp.maximum(cache.quant_err, jnp.max(kv_out[4])),
+                )
+            elif cache is not None:
                 new_cache = cache.replace(
                     k=kv_out[0], v=kv_out[1], index=cache.index + input_ids.shape[1]
                 )
@@ -695,17 +802,41 @@ class Transformer(nn.Module):
             layer_cls = DecoderLayer
             if cfg.remat and cache is None:
                 layer_cls = nn.remat(DecoderLayer, prevent_cse=False, policy=_remat_policy(cfg))
-            new_ks, new_vs = [], []
+            new_ks, new_vs, new_sks, new_svs, errs = [], [], [], [], []
+            paged = isinstance(cache, PagedKVCache)
             for i in range(cfg.num_layers):
                 if cache is None:
                     x = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+                elif paged:
+                    x, (pk_i, pv_i, sk_i, sv_i, err_i) = layer_cls(
+                        cfg, name=f"layers_{i}"
+                    )(
+                        x, positions,
+                        cache=(cache.pages_k[i], cache.pages_v[i],
+                               cache.k_scales[i], cache.v_scales[i],
+                               cache.tables, cache.index, cache.active),
+                    )
+                    new_ks.append(pk_i)
+                    new_vs.append(pv_i)
+                    new_sks.append(sk_i)
+                    new_svs.append(sv_i)
+                    errs.append(err_i)
                 else:
                     x, (k_i, v_i) = layer_cls(cfg, name=f"layers_{i}")(
                         x, positions, cache=(cache.k[i], cache.v[i], cache.index)
                     )
                     new_ks.append(k_i)
                     new_vs.append(v_i)
-            if cache is not None:
+            if paged:
+                new_cache = cache.replace(
+                    pages_k=jnp.stack(new_ks),
+                    pages_v=jnp.stack(new_vs),
+                    k_scales=jnp.stack(new_sks),
+                    v_scales=jnp.stack(new_svs),
+                    index=cache.index + input_ids.shape[1],
+                    quant_err=jnp.maximum(cache.quant_err, jnp.max(jnp.stack(errs))),
+                )
+            elif cache is not None:
                 new_cache = cache.replace(
                     k=jnp.stack(new_ks),
                     v=jnp.stack(new_vs),
@@ -739,6 +870,11 @@ class ScanBody(nn.Module):
         layer = DecoderLayer(self.config, name="layer")
         if kv[0] is None:
             return layer(x, positions), None
+        if len(kv) == 4:
+            # paged: kv = per-layer (pages_k, pages_v, k_scales, v_scales),
+            # cache_index = broadcast (tables, index, active)
+            x, new_kv = layer(x, positions, cache=tuple(kv) + tuple(cache_index))
+            return x, new_kv
         x, new_kv = layer(x, positions, cache=(kv[0], kv[1], cache_index))
         return x, new_kv
 

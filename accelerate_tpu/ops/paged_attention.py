@@ -1,0 +1,367 @@
+"""Paged decode attention: a Pallas kernel that reads KV straight from the page pool.
+
+The serving engine's paged windows (:mod:`accelerate_tpu.serving.pool`) keep
+every lane's KV in a shared refcounted page pool ``[num_pages, page, Hkv, D]``
+addressed through per-lane block tables.  PR 6 ran attention by *gathering*
+each lane's pages into a contiguous slab-width view — bitwise-identical logits,
+but every decode step moves ``pages_per_lane * page`` KV rows per lane through
+HBM even when the lane holds three tokens.  This module removes the gather:
+
+* :func:`paged_attention` — the Mosaic/pallas kernel.  Block tables and lane
+  lengths ride in as *scalar prefetch* operands, so the BlockSpec index maps
+  dereference ``tables[lane, p]`` and the pipeline fetches each KV page
+  **in place** — one grid program per (lane, kv-head) marching over that
+  lane's pages, online softmax (flash-style m/l/acc carry) over *valid* pages
+  only.  Dead table slots hold the null page, whose repeated block index the
+  pipeline does not re-fetch, and ``pl.when`` skips their compute: no
+  full-width gather, no padding reads.  GQA folds the ``rep`` query heads
+  sharing a KV head into the row dimension (same trick as
+  :mod:`.flash_attention`).  ``interpret=`` runs the identical kernel on CPU —
+  the tier-1 testing discipline.
+* :func:`paged_attention_reference` — pure-XLA oracle and fallback: a
+  live-masked page gather (the satellite fix — dead table slots gather the
+  null page instead of whole stale pages) feeding the exact
+  ``cached_attention`` program, so the native-dtype reference stays bitwise
+  identical to the slab pool.
+* :func:`paged_insert` / :func:`paged_quantized_insert` — the scatter-time
+  write path.  Quantized pages (int8, or fp8-e4m3 via the :mod:`.fp8` format
+  constants) store one f32 scale per (page, kv-head), written at scatter time:
+  each touched page is dequantized, the new rows inserted, positions past the
+  lane's write frontier zeroed (realloc'd pages carry a previous owner's
+  garbage, which must not inflate the scale), and the page requantized against
+  its own fresh amax.  When the page's amax is unchanged the old entries
+  round-trip exactly (they are integer multiples of the unchanged scale), so
+  repeated touches do not accumulate drift.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import DEFAULT_MASK_VALUE, NUM_LANES, _default_interpret
+from .fp8 import E4M3_MAX
+
+#: reserved garbage-sink page id — must match ``serving.paging.NULL_PAGE``
+NULL_PAGE = 0
+
+#: quantized KV storage formats: jnp dtype + the largest representable
+#: magnitude the per-page scale maps each head's amax onto
+KV_FORMATS = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, E4M3_MAX),
+}
+
+
+def kv_storage_dtype(kv_dtype: Optional[str], native):
+    """Resolve a ``ServingEngine(kv_dtype=...)`` string to the page dtype.
+    ``None`` keeps the model's native KV dtype (the token-identical path)."""
+    if kv_dtype is None:
+        return jnp.dtype(native)
+    if kv_dtype == "bf16":
+        return jnp.dtype(jnp.bfloat16)
+    if kv_dtype in KV_FORMATS:
+        return jnp.dtype(KV_FORMATS[kv_dtype][0])
+    raise ValueError(
+        f"unknown kv_dtype {kv_dtype!r}; choose None, 'bf16', 'int8' or 'fp8'"
+    )
+
+
+def kv_qmax(dtype) -> Optional[float]:
+    """The quantization ceiling for a page dtype; None for direct-store dtypes."""
+    for d, qmax in KV_FORMATS.values():
+        if jnp.dtype(dtype) == jnp.dtype(d):
+            return qmax
+    return None
+
+
+def _live_pages(lengths: jax.Array, s: int, page: int) -> jax.Array:
+    """Pages holding any key visible to this call's queries: keys
+    ``0 .. lengths + s - 1`` (the ``s`` new positions included)."""
+    return (lengths + s - 1) // page + 1
+
+
+# ------------------------------------------------------------------- writes
+def paged_insert(pages, new, tables, index, active):
+    """Scatter ``new [N, S, H, D]`` into ``pages [NP, page, H, D]`` at
+    positions ``index[n] .. index[n] + S - 1`` through lane ``n``'s block
+    table.  Inactive lanes are rerouted to the null page — a lane mid-prefill
+    has real (possibly shared) pages mapped and a stale index that must never
+    trample them.  Values are cast to the page dtype exactly as the slab pool
+    casts into its cache, so native-dtype storage stays bitwise identical."""
+    n, s, h, d = new.shape
+    page = pages.shape[1]
+    p_max = tables.shape[1] - 1
+    pos = index[:, None] + jnp.arange(s)[None, :]                    # [N, S]
+    pid = jnp.take_along_axis(tables, jnp.clip(pos // page, 0, p_max), axis=1)
+    pid = jnp.where(active[:, None], pid, NULL_PAGE)
+    off = pos % page
+    return pages.at[pid.reshape(-1), off.reshape(-1)].set(
+        new.astype(pages.dtype).reshape(n * s, h, d)
+    )
+
+
+def paged_quantized_insert(pages, scales, new, tables, index, active,
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Quantized scatter: requantize every page the ``S`` new positions touch.
+
+    ``pages [NP, page, H, D]`` (int8 / fp8-e4m3), ``scales [NP, H]`` f32 with
+    ``dequant = pages * scales``.  Returns ``(pages, scales, max_abs_err)``
+    where the error is the largest round-trip quantization error over the
+    newly written values — the measurable upper bound the engine exposes as
+    ``serve/kv_quant_error``.
+
+    Per touched page: dequantize, insert the new rows, zero every slot at or
+    past the lane's pre-call frontier that is not written now (stale KV from a
+    rolled-back speculation or a page's previous owner must not pollute the
+    amax), recompute the per-head scale from the page's own amax, requantize.
+    Writes for inactive lanes (and slots past each lane's touched span) are
+    rerouted to the null page.
+    """
+    qmax = kv_qmax(pages.dtype)
+    if qmax is None:
+        raise ValueError(f"pages dtype {pages.dtype} is not a quantized KV format")
+    n, s, h, d = new.shape
+    page = pages.shape[1]
+    p_max = tables.shape[1] - 1
+    t = (s + page - 2) // page + 1              # max pages a span of S can touch
+    p0 = index // page
+    pt = p0[:, None] + jnp.arange(t)[None, :]                        # [N, T]
+    last = (index + s - 1) // page
+    touched = (pt <= last[:, None]) & active[:, None]
+    pid = jnp.take_along_axis(tables, jnp.clip(pt, 0, p_max), axis=1)
+    pid = jnp.where(touched, pid, NULL_PAGE)                         # [N, T]
+
+    old = pages[pid].astype(jnp.float32) * scales[pid][:, :, None, :, None]
+    g = pt[:, :, None] * page + jnp.arange(page)[None, None, :]      # [N, T, page]
+    i_new = g - index[:, None, None]
+    use_new = (i_new >= 0) & (i_new < s)
+    gathered = jnp.take_along_axis(
+        new.astype(jnp.float32), jnp.clip(i_new, 0, s - 1).reshape(n, t * page)[:, :, None, None],
+        axis=1,
+    ).reshape(n, t, page, h, d)
+    keep_old = g < index[:, None, None]          # valid history, strictly pre-frontier
+    content = jnp.where(
+        use_new[..., None, None], gathered,
+        jnp.where(keep_old[..., None, None], old, 0.0),
+    )
+    amax = jnp.max(jnp.abs(content), axis=(2, 4))                    # [N, T, H]
+    new_scales = jnp.maximum(amax, 1e-8) / qmax
+    q = content / new_scales[:, :, None, :, None]
+    if jnp.dtype(pages.dtype) == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    q = q.astype(pages.dtype)
+    err = jnp.max(
+        jnp.where(
+            use_new[..., None, None],
+            jnp.abs(q.astype(jnp.float32) * new_scales[:, :, None, :, None] - content),
+            0.0,
+        )
+    )
+    flat = pid.reshape(-1)
+    pages = pages.at[flat].set(q.reshape(n * t, page, h, d))
+    scales = scales.at[flat].set(new_scales.reshape(n * t, h))
+    return pages, scales, err
+
+
+# ------------------------------------------------------------------ reference
+def paged_attention_reference(q, pages_k, pages_v, tables, lengths,
+                              k_scales=None, v_scales=None, window=None,
+                              alibi: bool = False):
+    """Pure-XLA oracle/fallback: live-masked gather + the slab attention math.
+
+    ``q [N, S, Hq, D]`` against pages ``[NP, page, Hkv, D]`` through
+    ``tables [N, P]``; query ``i`` of lane ``n`` sits at position
+    ``lengths[n] + i`` and sees keys ``j <= lengths[n] + i`` (the new
+    positions' KV must already be inserted).  Table slots past each lane's
+    live page count gather the null page instead of whole stale pages — the
+    gather moves only pages that can contain visible keys, and since masked
+    positions never reach the softmax the native-dtype output is bitwise
+    identical to the full gather (and so to the slab pool)."""
+    from ..models.transformer import cached_attention
+
+    n, s, _, d = q.shape
+    num_p = tables.shape[1]
+    page = pages_k.shape[1]
+    hkv = pages_k.shape[2]
+    live = _live_pages(lengths, s, page)
+    t = jnp.where(jnp.arange(num_p)[None, :] < live[:, None], tables, NULL_PAGE)
+    k = pages_k[t]                                    # [N, P, page, Hkv, D]
+    v = pages_v[t]
+    if k_scales is not None:
+        k = (k.astype(jnp.float32) * k_scales[t][:, :, None, :, None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * v_scales[t][:, :, None, :, None]).astype(q.dtype)
+    else:
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    k = k.reshape(n, num_p * page, hkv, d)
+    v = v.reshape(n, num_p * page, hkv, d)
+    q_positions = lengths[:, None] + jnp.arange(s)[None, :]
+    return cached_attention(q, k, v, q_positions, window=window, alibi=alibi)
+
+
+# --------------------------------------------------------------------- kernel
+def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                       ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                       page: int, s_len: int, scale: float, quantized: bool):
+    """One (lane, kv-head, page) step of the online softmax.
+
+    Row ``r`` of the folded query block holds query head ``h * rep + r //
+    s_len`` at sequence position ``lengths[lane] + r % s_len``.  The page loop
+    is the innermost grid dimension, so m/l/acc VMEM scratch carries across
+    it; pages at or past the lane's live count are skipped (their block index
+    degenerates to the null page, which the pipeline fetched at most once)."""
+    lane, p = pl.program_id(0), pl.program_id(2)
+    n_p = pl.num_programs(2)
+    gs = acc_ref.shape[0]
+    head_dim = acc_ref.shape[-1]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[lane]
+    live = (length + s_len - 1) // page + 1
+
+    @pl.when(p < live)
+    def _compute():
+        k = k_ref[0, :, 0, :]
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, 0]
+            v = v.astype(jnp.float32) * vs_ref[0, 0]
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                      # [GS, page]
+        j = p * page + jax.lax.broadcasted_iota(jnp.int32, (gs, page), 1)
+        qi = jax.lax.broadcasted_iota(jnp.int32, (gs, page), 0) % s_len
+        s = jnp.where(j <= length + qi, s, DEFAULT_MASK_VALUE)
+
+        if page >= NUM_LANES:
+            lane_bcast = lambda a: jnp.tile(a[:, :1], (1, page))
+        else:
+            lane_bcast = lambda a: a[:, :page]
+        if head_dim >= NUM_LANES:
+            acc_bcast = lambda a: jnp.tile(a[:, :1], (1, head_dim))
+        else:
+            acc_bcast = lambda a: a[:, :head_dim]
+
+        m_prev = m_ref[...]                                    # [GS, 128]
+        l_prev = l_ref[...]
+        m_curr = jnp.max(s, axis=1)[:, None]
+        m_next = jnp.maximum(m_prev, m_curr)
+        prob = jnp.exp(s - lane_bcast(m_next))
+        alpha = jnp.exp(m_prev - m_next)
+        m_ref[...] = m_next
+        l_ref[...] = alpha * l_prev + jnp.sum(prob, axis=1)[:, None]
+        pv = jax.lax.dot(
+            prob, v.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * acc_bcast(alpha) + pv
+
+    @pl.when(p == n_p - 1)
+    def _store():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[...] = (acc_ref[...] / acc_bcast_store(l_safe, head_dim))[None, None].astype(
+            o_ref.dtype
+        )
+
+
+def acc_bcast_store(a, head_dim: int):
+    if head_dim >= NUM_LANES:
+        return jnp.tile(a[:, :1], (1, head_dim))
+    return a[:, :head_dim]
+
+
+def paged_attention(q, pages_k, pages_v, tables, lengths, k_scales=None,
+                    v_scales=None, interpret: Optional[bool] = None):
+    """Decode attention over paged KV, reading pages in place.
+
+    Parameters
+    ----------
+    q: ``[N, S, Hq, D]`` queries for the ``S`` positions being written this
+        call (decode: 1; speculative verify: K+1).  Query ``i`` of lane ``n``
+        sits at position ``lengths[n] + i``.
+    pages_k, pages_v: the page pool ``[NP, page, Hkv, D]`` for ONE layer, with
+        this call's new KV already inserted (:func:`paged_insert` /
+        :func:`paged_quantized_insert`).
+    tables: ``[N, P]`` int32 per-lane block tables; dead slots hold the null
+        page.
+    lengths: ``[N]`` int32 — each lane's valid length before this call.
+    k_scales, v_scales: ``[NP, Hkv]`` f32 per-page-per-head dequantization
+        scales; required iff the pages are a quantized format.
+    interpret: run the kernel in pallas interpret mode (defaults to True off
+        TPU — the CPU testing discipline shared with
+        :mod:`.flash_attention`).
+
+    Returns ``[N, S, Hq, D]`` in ``q.dtype``.  Grid: one program per
+    (lane, kv-head) marching over the lane's pages innermost; GQA query heads
+    fold into rows so each KV page streams from HBM once per group.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    n, s, hq, d = q.shape
+    num_pages, page, hkv, _ = pages_k.shape
+    num_p = tables.shape[1]
+    rep = hq // hkv
+    gs = rep * s
+    quantized = kv_qmax(pages_k.dtype) is not None
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("quantized pages need k_scales/v_scales")
+    if not quantized:
+        # native dtype: feed dummy scales so the kernel signature is uniform
+        k_scales = jnp.ones((num_pages, hkv), jnp.float32)
+        v_scales = k_scales
+
+    # fold GQA groups into rows: row r = g * S + i  ->  head h*rep + g, query i
+    qf = (
+        q.transpose(0, 2, 1, 3)
+        .reshape(n, hkv, rep, s, d)
+        .reshape(n, hkv, gs, d)
+    )
+    lengths = lengths.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, hkv, num_p),
+        in_specs=[
+            pl.BlockSpec((1, 1, gs, d), lambda i, h, p, t, ln: (i, h, 0, 0)),
+            pl.BlockSpec((1, page, 1, d), lambda i, h, p, t, ln: (t[i, p], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, d), lambda i, h, p, t, ln: (t[i, p], 0, h, 0)),
+            pl.BlockSpec((1, 1), lambda i, h, p, t, ln: (t[i, p], h),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i, h, p, t, ln: (t[i, p], h),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gs, d), lambda i, h, p, t, ln: (i, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gs, NUM_LANES), jnp.float32),
+            pltpu.VMEM((gs, NUM_LANES), jnp.float32),
+            pltpu.VMEM((gs, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        page=page, s_len=s, scale=d ** -0.5, quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, hkv, gs, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, qf, pages_k, pages_v, k_scales, v_scales)
+    return (
+        out.reshape(n, hkv, rep, s, d)
+        .reshape(n, hq, s, d)
+        .transpose(0, 2, 1, 3)
+    )

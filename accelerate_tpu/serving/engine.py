@@ -144,6 +144,27 @@ class ServingEngine:
         ``num_pages`` can be far below ``num_slots * max_len / page_size``
         under mixed-length traffic.  Default is the no-preemption worst case
         (``num_slots * max_len / page_size + 1``).
+    decode_kernel: attention program for the paged decode/verify windows.
+        ``"xla"`` (default) gathers each lane's pages into a slab-width view
+        and runs the legacy attention einsum — bitwise token-identical with
+        the slab pool.  ``"pallas"`` reads KV pages *in place* through the
+        block tables (:mod:`accelerate_tpu.ops.paged_attention`): no gather
+        temporary, no padding reads — one grid program per (lane, kv-head)
+        with an online softmax over each lane's live pages only.  Same
+        compiled-shape budget (the kernel replaces the decode executables, it
+        does not add any); greedy outputs are token-identical in practice
+        (asserted by tests and ``bench_inference.py --kernel-ab``) but the
+        online softmax is not bitwise the full-view softmax.  Requires
+        ``paged=True``; full-causal rope/learned models only.
+    kv_dtype: KV page storage format (requires ``paged=True``).  ``None``
+        keeps the model dtype (token-identical); ``"bf16"`` stores bf16;
+        ``"int8"`` / ``"fp8"`` quantize pages with per-(page, kv-head) f32
+        scales written at scatter time and dequantized at attention — about
+        4x (fp32 models) / 2x (bf16) less KV HBM per token, so the same pool
+        bytes hold proportionally more concurrent lanes.  Quantized KV is
+        lossy: outputs track the native path within a logit tolerance
+        (``serve/kv_quant_error`` gauges the per-cycle round-trip error;
+        ``--kernel-ab`` hard-enforces a max-logit-divergence threshold).
     """
 
     def __init__(
@@ -167,6 +188,8 @@ class ServingEngine:
         paged: bool = False,
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
+        decode_kernel: str = "xla",
+        kv_dtype: Optional[str] = None,
     ):
         cfg = model.config
         self.model = model
@@ -206,6 +229,25 @@ class ServingEngine:
             )
 
         self.paged = bool(paged)
+        if decode_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"decode_kernel must be 'xla' or 'pallas', got {decode_kernel!r}"
+            )
+        if (decode_kernel != "xla" or kv_dtype is not None) and not self.paged:
+            raise ValueError(
+                "decode_kernel/kv_dtype act on the paged KV pool; pass paged=True"
+            )
+        self.decode_kernel = decode_kernel
+        self.kv_dtype = kv_dtype
+        from ..ops.paged_attention import kv_qmax, kv_storage_dtype
+
+        self.quantized = kv_qmax(kv_storage_dtype(kv_dtype, cfg.dtype)) is not None
+        # "direct" windows thread the page pool through the model
+        # (PagedKVCache) instead of the gather/scatter sandwich: required for
+        # in-place Pallas attention and for scale-aware quantized writes.
+        # Native-dtype XLA stays on the PR-6 gathered path — bitwise identity
+        # with the slab pool, plus the live-page gather mask.
+        self._direct = self.quantized or decode_kernel == "pallas"
         if self.paged:
             self.page_size = int(
                 page_size if page_size is not None
@@ -236,7 +278,7 @@ class ServingEngine:
             self.scratch = None
             self.kv = PagedKVPool(
                 cfg, self.num_slots, self.max_len, self.page_size,
-                self.num_pages, registry=self.metrics,
+                self.num_pages, registry=self.metrics, kv_dtype=kv_dtype,
             )
         else:
             self.pool = KVCache.create(cfg, self.num_slots, self.max_len, per_lane_index=True)
@@ -255,16 +297,40 @@ class ServingEngine:
         )
         if self.debug_server is not None:
             self.debug_server.add_collector(self.analyze_costs)
+        # Window models: the direct paged windows run a Transformer whose
+        # config selects the decode kernel (and interpret default).  The
+        # fields carry no parameters, so the engine's params serve every
+        # variant; prefill always runs the XLA reference program — chunk-wide
+        # queries gain nothing from a decode-shaped kernel, and it keeps the
+        # written KV identical across kernels.
+        if self.paged and self._direct:
+            kmodel = Transformer(dataclasses.replace(cfg, paged_kernel=decode_kernel))
+            pmodel = Transformer(dataclasses.replace(cfg, paged_kernel="xla"))
         # budget=1 per executable: the engine's whole design promises exactly
         # one compiled shape each — any second signature is a bug worth a warning
+        if self.paged and self._direct:
+            # nested watchdog: serve/paged_attn accounts the in-place paged
+            # attention executable itself (budget 1 — the kernel REPLACES the
+            # decode executable, it must never add shapes); serve/decode_window
+            # keeps its usual accounting on top.  Attribute forwarding lets
+            # jit_cache_sizes read straight through both layers.
+            decode_fn = RecompileWatchdog(
+                make_paged_decode_window(kmodel, self.window, direct=True),
+                name="serve/paged_attn", budget=1, registry=self.metrics,
+            )
+        elif self.paged:
+            decode_fn = make_paged_decode_window(model, self.window)
+        else:
+            decode_fn = make_decode_window(model, self.window)
         self._decode = RecompileWatchdog(
-            make_paged_decode_window(model, self.window) if self.paged
-            else make_decode_window(model, self.window),
-            name="serve/decode_window", budget=1, registry=self.metrics,
+            decode_fn, name="serve/decode_window", budget=1, registry=self.metrics,
         )
         self._prefill = {
             b: RecompileWatchdog(
-                make_paged_prefill_chunk(model, b, self.page_size) if self.paged
+                make_paged_prefill_chunk(
+                    pmodel if self.quantized else model, b, self.page_size,
+                    direct=self.quantized,
+                ) if self.paged
                 else make_prefill_chunk(model, b),
                 name=f"serve/prefill_{b}", budget=1, registry=self.metrics,
             )
@@ -278,7 +344,11 @@ class ServingEngine:
         )
         self._verify = (
             RecompileWatchdog(
-                make_paged_verify_window(model, self.speculate_k) if self.paged
+                make_paged_verify_window(
+                    kmodel, self.speculate_k, direct=True,
+                ) if (self.paged and self._direct)
+                else make_paged_verify_window(model, self.speculate_k)
+                if self.paged
                 else make_verify_window(model, self.speculate_k),
                 name="serve/verify_window", budget=1, registry=self.metrics,
             )
@@ -403,6 +473,22 @@ class ServingEngine:
             "serve/spec_accept_rate",
             help="accepted / proposed draft tokens (cumulative) under "
                  "speculative decoding",
+        )
+        self.metrics.gauge(
+            "serve/decode_kernel",
+            help="info gauge: decode attention program — 1 = pallas "
+                 "(in-place paged kernel), 0 = xla (gather reference)",
+        ).set(1.0 if self.decode_kernel == "pallas" else 0.0)
+        self._kv_quant_gauge = (
+            self.metrics.gauge(
+                "serve/kv_quant_error",
+                help="max abs KV round-trip quantization error of the values "
+                     "written this cycle (an upper-bound logit-divergence "
+                     "proxy; the --kernel-ab bench measures true logit "
+                     "deltas) — only published under quantized kv_dtype",
+            )
+            if self.quantized
+            else None
         )
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -618,6 +704,17 @@ class ServingEngine:
         self.kv.lane_append_owned(s, ids)
         kv = self.kv
         table = jnp.asarray(kv.tables[s])
+        if self.quantized:
+            args = (self.params, chunk[None], kv.pages_k, kv.pages_v,
+                    kv.k_scales, kv.v_scales, table, jnp.int32(start))
+            self.cost_table.capture(
+                f"serve/prefill_{bucket}", self._prefill[bucket], args,
+            )
+            with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
+                (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+                 qerr) = self._prefill[bucket](*args)
+            self._kv_quant_gauge.set(float(jax.device_get(qerr)))
+            return
         self.cost_table.capture(
             f"serve/prefill_{bucket}", self._prefill[bucket],
             (self.params, chunk[None], kv.pages_k, kv.pages_v, table,
@@ -761,8 +858,9 @@ class ServingEngine:
                 continue
             kv = self.kv
             with self.tracer.span("serve/copy_page", src=pid, dst=new[0]):
-                kv.pages_k, kv.pages_v = self._copy_page(
-                    kv.pages_k, kv.pages_v, jnp.int32(pid), jnp.int32(new[0])
+                kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales = self._copy_page(
+                    kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+                    jnp.int32(pid), jnp.int32(new[0])
                 )
             kv.lane_replace(s, pslot, new[0])
             self._bump("cow_copies")
@@ -881,7 +979,23 @@ class ServingEngine:
 
     def _decode_cycle(self, n_occupied: int) -> None:
         lanes = self._lane_arrays()
-        if self.paged:
+        if self.paged and self._direct:
+            kv = self.kv
+            tables = jnp.asarray(kv.tables)
+            index = jnp.asarray(self._lane_len)
+            args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
+                    kv.v_scales, tables, index, *lanes)
+            if not self.cost_table.captured("serve/decode_window"):
+                self.cost_table.capture("serve/decode_window", self._decode, args)
+            with self.tracer.span("serve/decode_window", occupied=n_occupied):
+                with self.tracer.span("serve/paged_attn", kernel=self.decode_kernel):
+                    (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, toks,
+                     pending, rngs, qerr) = self._decode(*args)
+                toks = np.asarray(jax.device_get(toks))
+            self._lane_len[self._active] += self.window
+            if self._kv_quant_gauge is not None:
+                self._kv_quant_gauge.set(float(jax.device_get(qerr)))
+        elif self.paged:
             kv = self.kv
             # block tables + write indices ride up fresh each cycle (a few KB
             # of int32 — allocation is host-side and can change every cycle)
@@ -949,7 +1063,25 @@ class ServingEngine:
             np.concatenate([self._pending_tok[:, None], drafts], axis=1)
         )
         n_drafted = int(drafted.sum())
-        if self.paged:
+        if self.paged and self._direct:
+            kv = self.kv
+            tables = jnp.asarray(kv.tables)
+            index = jnp.asarray(self._lane_len)
+            args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
+                    kv.v_scales, tables, index, tokens, *lanes[1:])
+            if not self.cost_table.captured("serve/verify_window"):
+                self.cost_table.capture("serve/verify_window", self._verify, args)
+            with self.tracer.span("serve/verify_window", occupied=n_occupied,
+                                  drafted=n_drafted):
+                with self.tracer.span("serve/paged_attn", kernel=self.decode_kernel):
+                    (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, out,
+                     n_commit, pending, rngs, qerr) = self._verify(*args)
+                out = np.asarray(jax.device_get(out))
+                n_commit = np.asarray(jax.device_get(n_commit))
+            self._lane_len[self._active] += n_commit[self._active]
+            if self._kv_quant_gauge is not None:
+                self._kv_quant_gauge.set(float(jax.device_get(qerr)))
+        elif self.paged:
             kv = self.kv
             tables = jnp.asarray(kv.tables)
             index = jnp.asarray(self._lane_len)

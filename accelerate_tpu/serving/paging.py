@@ -133,10 +133,18 @@ class PagedKVPool:
     num_pages: physical pages including the null page.  Must be at least
         ``max_len // page_size + 1`` so a single lane can always run to its
         capacity even with nothing else to reclaim.
+    kv_dtype: page storage format — ``None`` keeps ``config.dtype`` (the
+        token-identical path), ``"bf16"`` stores bf16, ``"int8"`` / ``"fp8"``
+        store quantized pages with one f32 dequantization scale per
+        (layer, page, kv-head) written at scatter time
+        (:func:`accelerate_tpu.ops.paged_attention.paged_quantized_insert`).
+        Scale arrays exist for every format (ones when direct-store) so the
+        compiled window signature does not fork on the dtype knob.
     """
 
     def __init__(self, config, num_slots: int, max_len: int, page_size: int,
-                 num_pages: int, registry: Optional[MetricsRegistry] = None):
+                 num_pages: int, registry: Optional[MetricsRegistry] = None,
+                 kv_dtype: Optional[str] = None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size {page_size} "
@@ -153,13 +161,26 @@ class PagedKVPool:
                 f"({self.pages_per_lane} pages) plus the null page"
             )
         cfg = config
+        from ..ops.paged_attention import kv_qmax, kv_storage_dtype
+
+        self.kv_dtype = kv_dtype
+        self.storage_dtype = kv_storage_dtype(kv_dtype, cfg.dtype)
+        self.quantized = kv_qmax(self.storage_dtype) is not None
         shape = (cfg.num_layers, self.num_pages, self.page_size,
                  cfg.num_kv_heads, cfg.resolved_head_dim)
-        self.pages_k = jnp.zeros(shape, cfg.dtype)
-        self.pages_v = jnp.zeros(shape, cfg.dtype)
-        #: bytes of k+v one page holds — the sharing/HBM accounting unit
+        self.pages_k = jnp.zeros(shape, self.storage_dtype)
+        self.pages_v = jnp.zeros(shape, self.storage_dtype)
+        # per-(layer, page, kv-head) dequantization scales; ones (a no-op
+        # multiply the direct-store windows never read) when not quantized
+        scale_shape = (cfg.num_layers, self.num_pages, cfg.num_kv_heads)
+        self.k_scales = jnp.ones(scale_shape, jnp.float32)
+        self.v_scales = jnp.ones(scale_shape, jnp.float32)
+        #: bytes of k+v one page holds, scales included — the sharing/HBM
+        #: accounting unit
+        itemsize = jnp.zeros((), self.storage_dtype).itemsize
         self.page_kv_bytes = 2 * int(
-            np.prod(shape[2:]) * cfg.num_layers * jnp.zeros((), cfg.dtype).itemsize
+            np.prod(shape[2:]) * cfg.num_layers * itemsize
+            + cfg.num_layers * cfg.num_kv_heads * 4
         )
         self.allocator = PageAllocator(self.num_pages)
         # host block tables: row s maps lane s's logical page slots to
@@ -179,6 +200,11 @@ class PagedKVPool:
             help="KV bytes extra references alias instead of copying "
                  "(sum of (refs-1) * page_bytes over shared pages)",
         )
+        registry.gauge(
+            "serve/kv_bytes_per_token",
+            help="KV HBM one token costs across all layers at the pool's "
+                 "storage dtype, amortized per-page scales included",
+        ).set(self.page_kv_bytes / self.page_size)
         self.publish_gauges()
 
     # -------------------------------------------------------------- lane ops
@@ -223,7 +249,10 @@ class PagedKVPool:
     # ------------------------------------------------------------- accounting
     def kv_bytes(self) -> int:
         """Device HBM held by the page arrays (the whole pool, null included)."""
-        return int(self.pages_k.nbytes) + int(self.pages_v.nbytes)
+        return (
+            int(self.pages_k.nbytes) + int(self.pages_v.nbytes)
+            + int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
+        )
 
     def publish_gauges(self) -> None:
         self._in_use_gauge.set(self.allocator.used_count)

@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import sample_tokens_batched
-from ..models.transformer import KVCache, Transformer
+from ..models.transformer import KVCache, PagedKVCache, Transformer
 from ..utils.jax_compat import jit_cache_size
 from .paging import NULL_PAGE
 
@@ -66,6 +66,13 @@ def _decode_scan(model: Transformer, window: int, params, cache, tokens, active,
     def step(carry, _):
         cache, tok, done, rngs = carry
         prev_index = cache.index
+        if isinstance(cache, PagedKVCache):
+            # direct paged cache: route frozen lanes' writes to the null page
+            # per step.  In the slab (and gathered-view) paths a frozen lane
+            # harmlessly overwrites its own dead slot, but a quantized page
+            # write REQUANTIZES the whole touched page — pad-token garbage
+            # must not keep churning a page that still holds real history.
+            cache = cache.replace(active=~done)
         logits, cache = model.apply({"params": params}, tok[:, None], cache=cache)
         # model.apply advanced every lane; frozen lanes roll back
         cache = cache.replace(
@@ -324,6 +331,20 @@ def _gather_view(pages, tables):
     return pages[:, tables].reshape(L, N, P * page, H, D)
 
 
+def _live_tables(tables, live):
+    """Mask table slots at or past each lane's live page count to the null
+    page, so gathers only move pages that can hold a visible key.  ``live``
+    is ``[N]`` (or scalar for the single prefill lane).  Bitwise-neutral: a
+    masked slot's positions sit past the lane's valid length, and the causal
+    mask already replaces their logits before the softmax — this just stops
+    the gather from reading whole stale pages to feed positions the mask
+    throws away."""
+    num_p = tables.shape[-1]
+    if jnp.ndim(live) == 0:
+        return jnp.where(jnp.arange(num_p) < live, tables, NULL_PAGE)
+    return jnp.where(jnp.arange(num_p)[None, :] < live[:, None], tables, NULL_PAGE)
+
+
 def _scatter_span(pages, view, tables, start, width: int, active):
     """Write ``view[:, n, start[n] : start[n] + width]`` back through lane
     ``n``'s block table, for every ACTIVE lane.  Positions are guaranteed
@@ -347,7 +368,8 @@ def _scatter_span(pages, view, tables, start, width: int, active):
     )
 
 
-def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int):
+def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int,
+                             direct: bool = False):
     """Paged prefill: ``(params, tokens [1, chunk_len], pages_k, pages_v,
     table [P], base) -> (pages_k, pages_v)``.
 
@@ -358,6 +380,13 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int)
     back.  ``base`` and the chunk span are page-aligned by construction: every
     bucket is a multiple of ``page_size`` and chunk starts are sums of
     buckets, so a chunk never writes into a shared page.
+
+    ``direct=True`` swaps the gather/scatter sandwich for the in-model paged
+    cache (:class:`~accelerate_tpu.models.transformer.PagedKVCache`): the
+    forward reads pages in place and the write path owns the per-page scales,
+    so quantized pools requantize each touched page against fresh content.
+    Signature becomes ``(params, tokens, pages_k, pages_v, k_scales, v_scales,
+    table [P], base) -> (pages_k, pages_v, k_scales, v_scales, quant_err)``.
     """
     if chunk_len % page_size != 0:
         raise ValueError(
@@ -365,12 +394,30 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int)
         )
     npg = chunk_len // page_size
 
+    if direct:
+        @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+        def direct_prefill_chunk(params, tokens, pages_k, pages_v, k_scales,
+                                 v_scales, table, base):
+            cache = PagedKVCache(
+                pages_k=pages_k, pages_v=pages_v,
+                k_scales=k_scales, v_scales=v_scales,
+                tables=table[None], index=base.reshape(1),
+                active=jnp.ones((1,), bool), quant_err=jnp.float32(0.0),
+            )
+            _, cache = model.apply({"params": params}, tokens, cache=cache)
+            return (cache.pages_k, cache.pages_v, cache.k_scales,
+                    cache.v_scales, cache.quant_err)
+
+        return direct_prefill_chunk
+
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def paged_prefill_chunk(params, tokens, pages_k, pages_v, table, base):
         L, _, page, H, D = pages_k.shape
+        live = (base + chunk_len - 1) // page_size + 1
+        gt = _live_tables(table, live)
         cache = KVCache(
-            k=_gather_view(pages_k, table[None]),
-            v=_gather_view(pages_v, table[None]),
+            k=_gather_view(pages_k, gt[None]),
+            v=_gather_view(pages_v, gt[None]),
             index=base,
         )
         _, cache = model.apply({"params": params}, tokens, cache=cache)
@@ -384,7 +431,8 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int)
     return paged_prefill_chunk
 
 
-def make_paged_decode_window(model: Transformer, window: int):
+def make_paged_decode_window(model: Transformer, window: int,
+                             direct: bool = False):
     """Paged decode: ``(params, pages_k, pages_v, tables [N, P], index [N],
     tokens, active, eos, do_sample, temperature, top_k, top_p, pad, rngs)
     -> (pages_k, pages_v, out_tokens [N, window], new_pending, new_rngs)``.
@@ -393,15 +441,47 @@ def make_paged_decode_window(model: Transformer, window: int):
     -> scatter the ``window`` written positions per lane.  The engine tracks
     each lane's index on the host (install/advance arithmetic is exact), so
     no index array needs to round-trip.
+
+    ``direct=True`` drops the gather/scatter sandwich: the model runs on a
+    :class:`~accelerate_tpu.models.transformer.PagedKVCache`, attention reads
+    pages in place (``config.paged_kernel`` picks pallas kernel vs XLA
+    reference) and writes go through the scale-aware paged insert — the
+    quantized-KV and Pallas fast paths.  Same traced ``_decode_scan`` body, so
+    sampling/freeze/EOS semantics cannot drift.  Signature gains the scale
+    arrays: ``(params, pages_k, pages_v, k_scales, v_scales, tables, index,
+    tokens, ...) -> (pages_k, pages_v, k_scales, v_scales, out_tokens,
+    new_pending, new_rngs, quant_err)``.
     """
+
+    if direct:
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def direct_decode_window(params, pages_k, pages_v, k_scales, v_scales,
+                                 tables, index, tokens, active, eos, do_sample,
+                                 temperature, top_k, top_p, pad, rngs):
+            cache = PagedKVCache(
+                pages_k=pages_k, pages_v=pages_v,
+                k_scales=k_scales, v_scales=v_scales,
+                tables=tables, index=index, active=active,
+                quant_err=jnp.float32(0.0),
+            )
+            cache, toks, tok, rngs = _decode_scan(
+                model, window, params, cache, tokens, active, eos, do_sample,
+                temperature, top_k, top_p, pad, rngs,
+            )
+            return (cache.pages_k, cache.pages_v, cache.k_scales,
+                    cache.v_scales, toks, tok, rngs, cache.quant_err)
+
+        return direct_decode_window
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def paged_decode_window(params, pages_k, pages_v, tables, index, tokens,
                             active, eos, do_sample, temperature, top_k, top_p,
                             pad, rngs):
+        page = pages_k.shape[2]
+        gt = _live_tables(tables, (index + window - 1) // page + 1)
         cache = KVCache(
-            k=_gather_view(pages_k, tables),
-            v=_gather_view(pages_v, tables),
+            k=_gather_view(pages_k, gt),
+            v=_gather_view(pages_v, gt),
             index=index,
         )
         cache, toks, tok, rngs = _decode_scan(
@@ -415,23 +495,50 @@ def make_paged_decode_window(model: Transformer, window: int):
     return paged_decode_window
 
 
-def make_paged_verify_window(model: Transformer, k: int):
+def make_paged_verify_window(model: Transformer, k: int, direct: bool = False):
     """Paged speculative verify: the slab :func:`_verify_body` over a gathered
     view, scattering all ``K+1`` written positions back (rejected positions'
     KV is unreachable past the committed index and gets overwritten later,
     exactly as in the slab path).  ``(params, pages_k, pages_v, tables, index,
     tokens [N, K+1], ...) -> (pages_k, pages_v, out, n_commit, new_pending,
     new_rngs)`` — the engine advances its host index mirror by ``n_commit``.
+
+    ``direct=True``: in-model paged cache (see
+    :func:`make_paged_decode_window`); signature gains the scale arrays and a
+    trailing ``quant_err``.
     """
     kp1 = k + 1
+
+    if direct:
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def direct_verify_window(params, pages_k, pages_v, k_scales, v_scales,
+                                 tables, index, tokens, active, eos, do_sample,
+                                 temperature, top_k, top_p, pad, rngs):
+            cache = PagedKVCache(
+                pages_k=pages_k, pages_v=pages_v,
+                k_scales=k_scales, v_scales=v_scales,
+                tables=tables, index=index, active=active,
+                quant_err=jnp.float32(0.0),
+            )
+            cache, out, n_commit, new_pending, new_rngs = _verify_body(
+                model, k, params, cache, tokens, active, eos, do_sample,
+                temperature, top_k, top_p, pad, rngs,
+            )
+            return (cache.pages_k, cache.pages_v, cache.k_scales,
+                    cache.v_scales, out, n_commit, new_pending, new_rngs,
+                    cache.quant_err)
+
+        return direct_verify_window
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def paged_verify_window(params, pages_k, pages_v, tables, index, tokens,
                             active, eos, do_sample, temperature, top_k, top_p,
                             pad, rngs):
+        page = pages_k.shape[2]
+        gt = _live_tables(tables, (index + kp1 - 1) // page + 1)
         cache = KVCache(
-            k=_gather_view(pages_k, tables),
-            v=_gather_view(pages_v, tables),
+            k=_gather_view(pages_k, gt),
+            v=_gather_view(pages_v, gt),
             index=index,
         )
         cache, out, n_commit, new_pending, new_rngs = _verify_body(
@@ -446,18 +553,22 @@ def make_paged_verify_window(model: Transformer, k: int):
 
 
 def make_copy_page():
-    """Jitted copy-on-write: ``(pages_k, pages_v, src, dst) -> (pages_k,
-    pages_v)`` duplicates one physical page.  Runs only when a lane's first
-    decode write lands in a page the prefix cache (or a sibling lane) still
-    references — at most once per admitted request, and never on the pure
-    aliasing hit path.  One compiled shape per engine, page-size-static.
+    """Jitted copy-on-write: ``(pages_k, pages_v, k_scales, v_scales, src,
+    dst) -> (pages_k, pages_v, k_scales, v_scales)`` duplicates one physical
+    page (dequantization scales ride along — a quantized copy is exact, both
+    pages decode identically).  Runs only when a lane's first decode write
+    lands in a page the prefix cache (or a sibling lane) still references —
+    at most once per admitted request, and never on the pure aliasing hit
+    path.  One compiled shape per engine, page-size-static.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def copy_page(pages_k, pages_v, src, dst):
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def copy_page(pages_k, pages_v, k_scales, v_scales, src, dst):
         pages_k = pages_k.at[:, dst].set(pages_k[:, src])
         pages_v = pages_v.at[:, dst].set(pages_v[:, src])
-        return pages_k, pages_v
+        k_scales = k_scales.at[:, dst].set(k_scales[:, src])
+        v_scales = v_scales.at[:, dst].set(v_scales[:, src])
+        return pages_k, pages_v, k_scales, v_scales
 
     return copy_page
 

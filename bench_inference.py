@@ -416,6 +416,263 @@ def _paged_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _quantized_logit_divergence(model, cfg, params, seq, plen, page, kv_dtype):
+    """True logit-divergence oracle for quantized KV pages.
+
+    Teacher-forces one completed sequence two ways and compares logits
+    position by position over the decode region:
+
+    * the exact reference — one full causal forward with no cache at all;
+    * a single-lane quantized :class:`PagedKVCache` replay, one token per
+      step through the SAME XLA paged-attention program the engine decodes
+      with, so every page requantization the engine would perform happens
+      here too.
+
+    Returns ``max |logits_quantized - logits_exact|`` — the number the
+    ``serve/kv_quant_error`` gauge only upper-bounds by proxy.
+    """
+    from accelerate_tpu.models.transformer import PagedKVCache
+    from accelerate_tpu.ops.paged_attention import kv_storage_dtype
+
+    seq = np.asarray(seq, np.int32)
+    t_total = len(seq)
+    exact = model.apply({"params": params}, jnp.asarray(seq)[None])
+
+    storage = kv_storage_dtype(kv_dtype, cfg.dtype)
+    n_pages = (t_total + page - 1) // page + 1  # + the null page
+    shape = (cfg.num_layers, n_pages, page, cfg.num_kv_heads, cfg.resolved_head_dim)
+    cache = PagedKVCache(
+        pages_k=jnp.zeros(shape, storage), pages_v=jnp.zeros(shape, storage),
+        k_scales=jnp.ones((cfg.num_layers, n_pages, cfg.num_kv_heads), jnp.float32),
+        v_scales=jnp.ones((cfg.num_layers, n_pages, cfg.num_kv_heads), jnp.float32),
+        tables=jnp.arange(1, n_pages, dtype=jnp.int32)[None],
+        index=jnp.zeros((1,), jnp.int32), active=jnp.ones((1,), bool),
+        quant_err=jnp.float32(0.0),
+    )
+
+    def step(c, tok):
+        logits, c = model.apply({"params": params}, tok[:, None], cache=c)
+        return c, logits[:, 0]
+
+    _, replay = jax.jit(lambda c, xs: jax.lax.scan(step, c, xs))(
+        cache, jnp.asarray(seq[:-1])[:, None]
+    )
+    # position t's logits predict token t+1; the decode region starts at the
+    # last prompt position (the engine's first generated token)
+    diff = jnp.abs(replay[:, 0] - exact[0, :-1])
+    return float(jnp.max(diff[plen - 1:]))
+
+
+def _kernel_ab_bench(args, model, cfg, params, preset):
+    """Decode-kernel / KV-dtype A/B on the paged engine (one JSON line).
+
+    Four arms, all paged, all the same heavy-tail workload:
+
+    * **xla** (baseline) — the PR-6 gathered reference program, native KV;
+    * **pallas** — the in-place paged-attention kernel, native KV.  Greedy
+      outputs must be token-identical to the xla arm or the bench exits
+      nonzero (the kernel swap must be invisible in the tokens);
+    * **quantized** (``--kv-dtype``, default int8) at the SAME lane/page
+      config — checked against a true max-logit-divergence oracle
+      (:func:`_quantized_logit_divergence`; hard limit ``--kv-quant-tol``)
+      and required to cut the KV pool bytes >= 40% and strictly shrink the
+      decode window's ``hbm_peak_bytes`` (whose weight/activation share
+      quantized KV cannot touch — the measured drop rides in ``detail``);
+    * a **capacity probe** pair at BYTE-EQUAL KV HBM — a page-starved native
+      arm vs a quantized arm whose pool holds the same bytes (so ~2x the
+      pages at bf16->int8): quantized peak concurrent lanes must be >= 1.8x.
+
+    The headline metric is the pallas/xla tokens/s ratio; everything else
+    rides in ``detail``.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)
+    window = args.decode_window
+    mp = max(16, min(args.seq, cfg.max_seq_len) // 2)
+    page = max(4, mp // 4)
+    buckets = (page, 2 * page)
+    max_len = (min(cfg.max_seq_len, 2 * mp) // page) * page
+    pages_per_lane = max_len // page
+    slots = args.batch
+
+    # the paged-ab heavy-tail chat mix: every 8th prompt long, the rest short
+    r = np.random.default_rng(args.serve_seed)
+    n = args.requests
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(4, mp // 12)), 0.6, n)), 4, page - 1
+    ).astype(int)
+    long_idx = np.arange(0, n, 8)
+    prompt_lens[long_idx] = r.integers(3 * mp // 4, mp + 1, long_idx.size)
+    prompts = [
+        r.integers(1, cfg.vocab_size, (int(p),)).astype(np.int32)
+        for p in prompt_lens
+    ]
+    out_cap = max(window, (max_len - mp - window) // 2)
+    out_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(window, out_cap // 4)), 0.6, n)),
+        window, out_cap,
+    ).astype(int)
+    gens = [GenerationConfig(max_new_tokens=int(o)) for o in out_lens]
+    useful_tokens = int(out_lens.sum())
+
+    def run_arm(kernel, kv_dtype, num_pages, num_slots, workload):
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            model, params, num_slots=num_slots, max_len=max_len,
+            max_prompt_len=max_len, prefill_buckets=buckets,
+            decode_window=window, registry=registry, prefix_cache_mb=0,
+            paged=True, page_size=page, num_pages=num_pages,
+            decode_kernel=kernel, kv_dtype=kv_dtype,
+        )
+        warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets]
+        eng.serve(warm, GenerationConfig(max_new_tokens=window))
+        for k in eng.stats:
+            eng.stats[k] = 0
+        eng.peak_active_lanes = 0
+        registry.reset()
+        t0 = time.perf_counter()
+        reqs = eng.serve(workload[0], workload[1])
+        dt = time.perf_counter() - t0
+        return eng, reqs, dt, registry
+
+    roomy = slots * pages_per_lane + 1  # pressure never binds the equal arms
+    mix = (prompts, gens)
+    eng_x, reqs_x, dt_x, reg_x = run_arm("xla", None, roomy, slots, mix)
+    eng_p, reqs_p, dt_p, reg_p = run_arm("pallas", None, roomy, slots, mix)
+    eng_q, reqs_q, dt_q, reg_q = run_arm("xla", args.kv_dtype, roomy, slots, mix)
+
+    if [q.tokens for q in reqs_p] != [q.tokens for q in reqs_x]:
+        raise SystemExit(
+            "pallas decode kernel changed greedy outputs: pallas-arm tokens "
+            "differ from the xla reference arm on the same workload"
+        )
+
+    # quantized accuracy: replay the longest completed sequence against the
+    # exact no-cache forward and bound the true logit divergence
+    longest = max(range(n), key=lambda i: len(prompts[i]) + len(reqs_q[i].tokens))
+    seq = np.concatenate([prompts[longest], np.asarray(reqs_q[longest].tokens, np.int32)])
+    divergence = _quantized_logit_divergence(
+        model, cfg, params, seq, len(prompts[longest]), page, args.kv_dtype
+    )
+    if divergence > args.kv_quant_tol:
+        raise SystemExit(
+            f"quantized KV ({args.kv_dtype}) max logit divergence {divergence:.3f} "
+            f"exceeds --kv-quant-tol {args.kv_quant_tol} on the replay oracle"
+        )
+
+    # quantized memory: the page pool itself, and the decode executable's
+    # XLA-reported HBM peak, must both shrink >= 40% at the SAME lane count
+    kv_drop = 1.0 - eng_q.kv.kv_bytes() / eng_x.kv.kv_bytes()
+    if kv_drop < 0.4:
+        raise SystemExit(
+            f"quantized KV pool shrank only {100 * kv_drop:.1f}% "
+            f"({eng_q.kv.kv_bytes()} vs {eng_x.kv.kv_bytes()} bytes); >= 40% required"
+        )
+    # the executable-wide serve/hbm_peak_bytes also carries weights and
+    # activations, which quantized KV cannot touch — so the hard check there
+    # is strict improvement, with the measured drop reported alongside
+    eng_x.analyze_costs()
+    eng_q.analyze_costs()
+    hbm_x = eng_x.cost_table.max_hbm_peak_bytes()
+    hbm_q = eng_q.cost_table.max_hbm_peak_bytes()
+    hbm_drop = 1.0 - hbm_q / hbm_x if hbm_x else None
+    if hbm_x and hbm_q >= hbm_x:
+        raise SystemExit(
+            f"quantized KV failed to shrink serve/hbm_peak_bytes "
+            f"({hbm_q} vs {hbm_x}) at equal lanes"
+        )
+
+    # capacity probe at byte-equal KV HBM: uniform near-full-lane requests so
+    # concurrency is page-bound, a native pool two lanes wide vs a quantized
+    # pool of exactly the same bytes (integer page count rounds DOWN — the
+    # quantized arm absorbs the handicap)
+    probe_n = max(8, n // 2)
+    probe_prompts = [
+        r.integers(1, cfg.vocab_size, (mp,)).astype(np.int32) for _ in range(probe_n)
+    ]
+    probe_gens = [GenerationConfig(max_new_tokens=max_len - mp - window)] * probe_n
+    probe_slots = max(slots, 8)
+    pages_native = 2 * pages_per_lane + 1
+    native_bytes = pages_native * eng_x.kv.page_kv_bytes
+    pages_quant = native_bytes // eng_q.kv.page_kv_bytes
+    probe = (probe_prompts, probe_gens)
+    eng_cn, _, dt_cn, _ = run_arm("xla", None, pages_native, probe_slots, probe)
+    eng_cq, _, dt_cq, _ = run_arm("xla", args.kv_dtype, pages_quant, probe_slots, probe)
+    if eng_cq.kv.kv_bytes() > eng_cn.kv.kv_bytes():
+        raise SystemExit(
+            f"capacity probe budgets diverged: quantized pool {eng_cq.kv.kv_bytes()} "
+            f"bytes exceeds native {eng_cn.kv.kv_bytes()} — only meaningful at "
+            "byte-equal KV HBM"
+        )
+    lane_ratio = eng_cq.peak_active_lanes / max(1, eng_cn.peak_active_lanes)
+    if lane_ratio < 1.8:
+        raise SystemExit(
+            f"byte-equal quantized pool peaked at {eng_cq.peak_active_lanes} lanes vs "
+            f"native {eng_cn.peak_active_lanes} ({lane_ratio:.2f}x); >= 1.8x required"
+        )
+
+    def arm_detail(eng, reqs, dt, registry):
+        ttft = registry.get("serve/ttft_s").snapshot()
+        out = {
+            "tokens_per_s": round(useful_tokens / dt, 2),
+            "wall_s": round(dt, 3),
+            "ttft_p50_ms": round(1e3 * ttft["p50"], 2),
+            "kv_pool_bytes": eng.kv.kv_bytes(),
+            "peak_active_lanes": eng.peak_active_lanes,
+            "outputs_token_identical": [q.tokens for q in reqs] == [q.tokens for q in reqs_x],
+            "compiled_executables": eng.compiled_executable_counts(),
+            "watchdog_over_budget": eng._decode.over_budget(),
+        }
+        snap = registry.snapshot()
+        if "serve/kv_quant_error" in snap:
+            out["kv_quant_error"] = round(snap["serve/kv_quant_error"], 6)
+        # set once at pool construction (the pre-timing registry reset wiped
+        # the gauge), so recompute from the pool itself
+        out["kv_bytes_per_token"] = round(eng.kv.page_kv_bytes / eng.kv.page_size, 2)
+        return out
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "num_slots": slots,
+        "decode_window": window,
+        "page_size": page,
+        "num_pages": roomy,
+        "max_len": max_len,
+        "kv_dtype": args.kv_dtype,
+        "useful_tokens": useful_tokens,
+        "xla": arm_detail(eng_x, reqs_x, dt_x, reg_x),
+        "pallas": arm_detail(eng_p, reqs_p, dt_p, reg_p),
+        "quantized": arm_detail(eng_q, reqs_q, dt_q, reg_q),
+        "quantized_max_logit_divergence": round(divergence, 6),
+        "kv_quant_tol": args.kv_quant_tol,
+        "kv_pool_drop": round(kv_drop, 3),
+        "hbm_peak_drop": round(hbm_drop, 3) if hbm_drop is not None else None,
+        "capacity_probe": {
+            "requests": probe_n,
+            "num_slots": probe_slots,
+            "native_pages": pages_native,
+            "quantized_pages": int(pages_quant),
+            "native_peak_lanes": eng_cn.peak_active_lanes,
+            "quantized_peak_lanes": eng_cq.peak_active_lanes,
+            "native_wall_s": round(dt_cn, 3),
+            "quantized_wall_s": round(dt_cq, 3),
+            "peak_lanes_ratio": round(lane_ratio, 3),
+        },
+    }
+    return {
+        "metric": "serving_pallas_vs_xla_tokens_per_sec_ratio",
+        "value": round((useful_tokens / dt_p) / (useful_tokens / dt_x), 3),
+        "unit": "x",
+        "vs_baseline": round(dt_x / dt_p, 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -434,11 +691,15 @@ def _serve_bench(args, model, cfg, params, preset):
     requests), outputs are asserted token-identical between the two runs, and
     ``detail.prefix_hit_rate`` records the reuse the radix cache found.
     """
+    if sum([bool(getattr(args, "paged_ab", False)),
+            bool(getattr(args, "kernel_ab", False)),
+            bool(args.shared_prefix)]) > 1:
+        raise SystemExit("--paged-ab, --kernel-ab and --shared-prefix are "
+                         "separate serve workloads; pick one")
     if getattr(args, "paged_ab", False):
-        if args.shared_prefix:
-            raise SystemExit("--paged-ab and --shared-prefix are separate "
-                             "serve workloads; pick one")
         return _paged_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "kernel_ab", False):
+        return _kernel_ab_bench(args, model, cfg, params, preset)
 
     from accelerate_tpu.models.generation import GenerationConfig, generate
     from accelerate_tpu.serving import ServingEngine
@@ -627,6 +888,20 @@ def main():
                         help="--task serve: A/B the paged KV allocator against "
                              "the legacy slab pool at the same KV HBM budget "
                              "on a heavy-tail workload (token-identical check)")
+    parser.add_argument("--kernel-ab", dest="kernel_ab", action="store_true",
+                        help="--task serve: A/B decode kernels and KV dtypes on "
+                             "the paged engine (xla vs pallas, native vs "
+                             "--kv-dtype) — token-identity and logit-divergence "
+                             "hard checks, plus a byte-equal capacity probe")
+    parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
+                        default="int8",
+                        help="--kernel-ab: quantized KV page format for the "
+                             "quantized arms")
+    parser.add_argument("--kv-quant-tol", dest="kv_quant_tol", type=float,
+                        default=1.5,
+                        help="--kernel-ab: max tolerated logit divergence on "
+                             "the quantized replay oracle (the bench exits "
+                             "nonzero above it)")
     parser.add_argument("--prefix-cache-mb", dest="prefix_cache_mb", type=float,
                         default=64.0,
                         help="serve task: prefix KV cache byte budget (MiB) for "

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Lint: every ``pl.pallas_call`` must thread an ``interpret=`` kwarg.
+
+Pallas kernels only run compiled on a real TPU; everywhere else (CPU CI, dev
+laptops, the CPU half of a TPU pod host) they need ``interpret=True`` to run
+at all.  The repo's convention is that every kernel entry point accepts an
+``interpret`` argument defaulting to ``_default_interpret()`` (off-TPU
+autodetection — see ``accelerate_tpu/ops/flash_attention.py``) and threads it
+into the ``pallas_call``.  A ``pallas_call`` with no ``interpret=`` kwarg
+hard-codes TPU-only behavior and breaks the CPU A/B oracles the test suite is
+built on, so it is a lint error even when the kernel "is only meant for TPU".
+
+A ``**kwargs`` splat at the call site counts as threading (the kwarg may
+arrive dynamically); lines carrying a ``# noqa: pallas-interpret`` pragma are
+exempt.
+
+Exit status 1 with one ``path:line`` diagnostic per violation; 0 when clean.
+Wired into ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "accelerate_tpu"
+PRAGMA = "noqa: pallas-interpret"
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    """Matches ``pl.pallas_call(...)`` / ``pallas_call(...)`` under any alias
+    whose attribute name is exactly ``pallas_call``."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "pallas_call"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "pallas_call"
+    return False
+
+
+def check_file(path: Path) -> list:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:  # quality target also runs compileall; be loud
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+    src_lines = source.splitlines()
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_pallas_call(node):
+            continue
+        names = {kw.arg for kw in node.keywords}  # None marks a **splat
+        if "interpret" in names or None in names:
+            continue
+        if PRAGMA in src_lines[node.lineno - 1]:
+            continue
+        rel = path.relative_to(REPO_ROOT)
+        violations.append(
+            f"{rel}:{node.lineno}: pallas_call without interpret= — thread the "
+            "caller's interpret flag (default _default_interpret()) so the "
+            "kernel runs off-TPU"
+        )
+    return violations
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        violations.extend(check_file(path))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_pallas_interpret: {len(violations)} violation(s)")
+        return 1
+    print("check_pallas_interpret: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
