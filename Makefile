@@ -51,6 +51,7 @@ bench-serve:
 	python bench_inference.py --task serve --shared-prefix 16
 	python bench_inference.py --task serve --paged-ab
 	python bench_inference.py --task serve --kernel-ab
+	python bench_inference.py --task serve --tp-ab
 	python bench_inference.py --task spec
 
 quality:
@@ -60,3 +61,4 @@ quality:
 	python tools/check_no_method_lru_cache.py
 	python tools/check_pallas_interpret.py
 	python tools/check_metric_docs.py
+	python tools/check_sharding_annotations.py

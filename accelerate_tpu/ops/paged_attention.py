@@ -80,6 +80,18 @@ def kv_qmax(dtype) -> Optional[float]:
     return None
 
 
+def resolve_paged_kernel(kernel: str, mesh=None, tp_axis: str = "tp") -> str:
+    """Shard-aware kernel dispatch: under a tensor-parallel mesh the Pallas
+    grid would read whole ``(kv-head, page)`` tiles of a head-sharded pool, so
+    ``"pallas"`` falls back to :func:`paged_attention_reference` — the pure-XLA
+    einsum partitions head-parallel under GSPMD for free.  tp=1 meshes (and no
+    mesh at all) keep the requested kernel."""
+    if kernel != "pallas" or mesh is None:
+        return kernel
+    tp = mesh.shape[tp_axis] if tp_axis in mesh.axis_names else 1
+    return "xla" if tp > 1 else kernel
+
+
 def _live_pages(lengths: jax.Array, s: int, page: int) -> jax.Array:
     """Pages holding any key visible to this call's queries: keys
     ``0 .. lengths + s - 1`` (the ``s`` new positions included)."""

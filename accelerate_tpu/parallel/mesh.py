@@ -125,6 +125,33 @@ def build_mesh(
     return Mesh(dev_array, names)
 
 
+def replica_meshes(
+    n_replicas: int,
+    axes: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> List[Mesh]:
+    """Split the device list into ``n_replicas`` disjoint groups and build one
+    mesh per group — the substrate for data-parallel engine replicas behind a
+    :class:`~accelerate_tpu.serving.router.ReplicaRouter`.  Each replica mesh
+    carries the same ``axes`` (e.g. ``{"tp": 2}``); with ``axes=None`` each
+    replica owns a single device."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_replicas <= 0:
+        raise ValueError(f"n_replicas must be positive, got {n_replicas}")
+    per = math.prod((axes or {"dp": 1}).values())
+    if per * n_replicas > len(devices):
+        raise ValueError(
+            f"{n_replicas} replicas x {per} devices/replica exceeds "
+            f"{len(devices)} available devices"
+        )
+    return [
+        build_mesh(dict(axes) if axes else {"dp": 1},
+                   devices=devices[i * per:(i + 1) * per])
+        for i in range(n_replicas)
+    ]
+
+
 def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = True,
               axis_names=None):
     """Version-portable ``shard_map`` (use this, not ``jax.shard_map``).

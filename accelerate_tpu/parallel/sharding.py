@@ -161,5 +161,13 @@ def shard_pytree(tree, rule: Callable[[Any], NamedSharding]):
     return jax.jit(lambda t: t, out_shardings=shardings)(tree), shardings
 
 
+def shard_pytree_with_path(tree, rule):
+    """Like :func:`shard_pytree` but for *path-aware* rules ``(path, leaf) ->
+    NamedSharding`` (e.g. :func:`..tensor_parallel.make_tp_sharding_fn`), which
+    need the param name to pick the sharded dim."""
+    shardings = jax.tree_util.tree_map_with_path(rule, tree)
+    return jax.jit(lambda t: t, out_shardings=shardings)(tree), shardings
+
+
 def sharding_of(tree):
     return jax.tree_util.tree_map(lambda x: x.sharding if isinstance(x, jax.Array) else None, tree)

@@ -40,6 +40,21 @@ DEFAULT_TP_RULES: Tuple[Tuple[str, str], ...] = (
     (r"embed_tokens/embedding$", "vocab"),
 )
 
+# Serving variant: column-parallel projections ONLY.  Row-parallel layers
+# (``o_proj``/``down_proj`` sharded on the *contracting* dim) finish with a
+# psum whose cross-device reduction order differs from the single-device
+# matmul — a few-ulp drift that compounds over autoregressive decode steps
+# until a greedy argmax flips.  Serving promises token-identical output at
+# every tp degree (the ``--tp-ab`` bench enforces it bitwise), so those
+# layers and the embedding gather stay replicated: every reduction a sharded
+# serve executes runs over the same unsharded operands, in the same order,
+# as its tp=1 twin.  Column-parallel q/k/v is also what keeps the paged KV
+# pool head-sharded end to end — the cache writes land on the shard that
+# computed them, no resharding collective in the decode loop.
+SERVING_TP_RULES: Tuple[Tuple[str, str], ...] = (
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)/kernel$", "out"),
+)
+
 
 def path_to_str(path) -> str:
     parts = []

@@ -8,6 +8,7 @@ pool becomes a refcounted page pool behind per-lane block tables
 from .engine import ServingEngine
 from .paging import NULL_PAGE, PageAllocator, PagedKVPool
 from .pool import (
+    ServeShardings,
     jit_cache_sizes,
     make_copy_chunk,
     make_copy_page,
@@ -21,11 +22,14 @@ from .pool import (
     plan_chunks,
 )
 from .prefix_cache import PrefixCache, PrefixNode, rolling_hash
+from .router import ReplicaRouter
 from .scheduler import Request, RequestState, Scheduler
 from .spec import propose_ngram_draft
 
 __all__ = [
     "ServingEngine",
+    "ReplicaRouter",
+    "ServeShardings",
     "Request",
     "RequestState",
     "Scheduler",

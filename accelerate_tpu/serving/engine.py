@@ -60,6 +60,7 @@ from ..telemetry import (
 )
 from .paging import PagedKVPool
 from .pool import (
+    ServeShardings,
     jit_cache_sizes,
     make_copy_chunk,
     make_copy_page,
@@ -165,6 +166,24 @@ class ServingEngine:
         lossy: outputs track the native path within a logit tolerance
         (``serve/kv_quant_error`` gauges the per-cycle round-trip error;
         ``--kernel-ab`` hard-enforces a max-logit-divergence threshold).
+    mesh: a named :class:`jax.sharding.Mesh` for tensor-parallel serving
+        (``None``, the default, keeps single-chip behavior byte-for-byte).
+        With a ``tp_axis`` of size > 1: params shard by the
+        :data:`~accelerate_tpu.parallel.tensor_parallel.DEFAULT_TP_RULES`,
+        the KV pool (slab or paged) shards on the kv-head axis, and every
+        window executable compiles with explicit in/out shardings
+        (:class:`~accelerate_tpu.serving.pool.ServeShardings`) — one model
+        spans the axis while block tables, scheduler, prefix-cache radix
+        tree, and telemetry stay host-side and replicated.  Greedy outputs
+        are token-identical to tp=1 at every (kernel, kv_dtype, paged)
+        combination and the compiled-executable budget is unchanged; both
+        are pinned by ``tests/test_serving_mesh.py`` and
+        ``bench_inference.py --task serve --tp-ab``.  ``decode_kernel=
+        "pallas"`` falls back to the XLA reference under tp > 1 (the Pallas
+        grid reads whole head tiles; the einsum partitions head-parallel).
+        Head counts must divide the tp degree.
+    tp_axis: mesh axis name the KV heads and weight matrices shard over
+        (default ``"tp"``); axes absent from the mesh count as size 1.
     """
 
     def __init__(
@@ -190,6 +209,8 @@ class ServingEngine:
         num_pages: Optional[int] = None,
         decode_kernel: str = "xla",
         kv_dtype: Optional[str] = None,
+        mesh=None,
+        tp_axis: str = "tp",
     ):
         cfg = model.config
         self.model = model
@@ -237,9 +258,18 @@ class ServingEngine:
             raise ValueError(
                 "decode_kernel/kv_dtype act on the paged KV pool; pass paged=True"
             )
+        from ..ops.paged_attention import (
+            kv_qmax,
+            kv_storage_dtype,
+            resolve_paged_kernel,
+        )
+
+        # shard-aware kernel dispatch: under a tp>1 mesh the Pallas grid would
+        # read whole (kv-head, page) tiles of a head-sharded pool, so "pallas"
+        # resolves to the XLA reference (head-parallel under GSPMD for free)
+        decode_kernel = resolve_paged_kernel(decode_kernel, mesh, tp_axis)
         self.decode_kernel = decode_kernel
         self.kv_dtype = kv_dtype
-        from ..ops.paged_attention import kv_qmax, kv_storage_dtype
 
         self.quantized = kv_qmax(kv_storage_dtype(kv_dtype, cfg.dtype)) is not None
         # "direct" windows thread the page pool through the model
@@ -268,6 +298,41 @@ class ServingEngine:
                 num_pages if num_pages is not None
                 else self.num_slots * (self.max_len // self.page_size) + 1
             )
+        # ------------------------------------------------------ mesh / tp
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            from ..parallel.mesh import mesh_axis_size
+            from ..parallel.sharding import shard_pytree_with_path
+            from ..parallel.tensor_parallel import (
+                SERVING_TP_RULES,
+                make_tp_sharding_fn,
+            )
+
+            self.tp_degree = mesh_axis_size(mesh, tp_axis)
+            if self.tp_degree > 1 and (
+                cfg.num_heads % self.tp_degree != 0
+                or cfg.num_kv_heads % self.tp_degree != 0
+            ):
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} / num_kv_heads "
+                    f"{cfg.num_kv_heads} must divide evenly over "
+                    f"tp={self.tp_degree}"
+                )
+            # SERVING_TP_RULES, not DEFAULT_TP_RULES: row-parallel psum would
+            # break bitwise token identity vs tp=1 (see tensor_parallel.py)
+            self.params, param_shardings = shard_pytree_with_path(
+                params,
+                make_tp_sharding_fn(
+                    mesh, axis_name=tp_axis, rules=SERVING_TP_RULES
+                ),
+            )
+            self._shardings = ServeShardings(
+                mesh, param_shardings, tp_axis=tp_axis
+            )
+        else:
+            self.tp_degree = 1
+            self._shardings = None
         self.metrics = registry if registry is not None else get_registry()
         # device state: per-lane-index slab pool + batch-1 prefill scratch
         # (legacy), or the shared page pool + host block tables (paged — no
@@ -279,11 +344,19 @@ class ServingEngine:
             self.kv = PagedKVPool(
                 cfg, self.num_slots, self.max_len, self.page_size,
                 self.num_pages, registry=self.metrics, kv_dtype=kv_dtype,
+                mesh=mesh, tp_axis=tp_axis,
             )
         else:
             self.pool = KVCache.create(cfg, self.num_slots, self.max_len, per_lane_index=True)
             self.scratch = KVCache.create(cfg, 1, self.max_prompt_len)
             self.kv = None
+            if self._shardings is not None:
+                # the slab pool and scratch carry kv heads on dim 3, exactly
+                # like the page arrays — place them before the first compile
+                self.pool = jax.device_put(self.pool, self._shardings.cache())
+                self.scratch = jax.device_put(
+                    self.scratch, self._shardings.cache()
+                )
         self.tracer = get_tracer()
         # Forensics + cost accounting (docs/usage/observability.md): request
         # lifecycle events land in the process flight recorder, per-executable
@@ -315,13 +388,16 @@ class ServingEngine:
             # keeps its usual accounting on top.  Attribute forwarding lets
             # jit_cache_sizes read straight through both layers.
             decode_fn = RecompileWatchdog(
-                make_paged_decode_window(kmodel, self.window, direct=True),
+                make_paged_decode_window(kmodel, self.window, direct=True,
+                                         shardings=self._shardings),
                 name="serve/paged_attn", budget=1, registry=self.metrics,
             )
         elif self.paged:
-            decode_fn = make_paged_decode_window(model, self.window)
+            decode_fn = make_paged_decode_window(model, self.window,
+                                                 shardings=self._shardings)
         else:
-            decode_fn = make_decode_window(model, self.window)
+            decode_fn = make_decode_window(model, self.window,
+                                           shardings=self._shardings)
         self._decode = RecompileWatchdog(
             decode_fn, name="serve/decode_window", budget=1, registry=self.metrics,
         )
@@ -329,9 +405,9 @@ class ServingEngine:
             b: RecompileWatchdog(
                 make_paged_prefill_chunk(
                     pmodel if self.quantized else model, b, self.page_size,
-                    direct=self.quantized,
+                    direct=self.quantized, shardings=self._shardings,
                 ) if self.paged
-                else make_prefill_chunk(model, b),
+                else make_prefill_chunk(model, b, shardings=self._shardings),
                 name=f"serve/prefill_{b}", budget=1, registry=self.metrics,
             )
             for b in self.buckets
@@ -339,17 +415,21 @@ class ServingEngine:
         self._insert = (
             None if self.paged
             else RecompileWatchdog(
-                make_insert(), name="serve/insert", budget=1, registry=self.metrics
+                make_insert(shardings=self._shardings), name="serve/insert",
+                budget=1, registry=self.metrics
             )
         )
         self._verify = (
             RecompileWatchdog(
                 make_paged_verify_window(
                     kmodel, self.speculate_k, direct=True,
+                    shardings=self._shardings,
                 ) if (self.paged and self._direct)
-                else make_paged_verify_window(model, self.speculate_k)
+                else make_paged_verify_window(model, self.speculate_k,
+                                              shardings=self._shardings)
                 if self.paged
-                else make_verify_window(model, self.speculate_k),
+                else make_verify_window(model, self.speculate_k,
+                                        shardings=self._shardings),
                 name="serve/verify_window", budget=1, registry=self.metrics,
             )
             if self.speculate_k
@@ -357,7 +437,8 @@ class ServingEngine:
         )
         self._copy_page = (
             RecompileWatchdog(
-                make_copy_page(), name="serve/copy_page", budget=1,
+                make_copy_page(shardings=self._shardings),
+                name="serve/copy_page", budget=1,
                 registry=self.metrics,
             )
             if self.paged
@@ -376,7 +457,7 @@ class ServingEngine:
                 if self.paged
                 else {
                     b: RecompileWatchdog(
-                        make_copy_chunk(b),
+                        make_copy_chunk(b, shardings=self._shardings),
                         name=f"serve/copy_{b}", budget=1, registry=self.metrics,
                     )
                     for b in self.buckets
@@ -467,7 +548,8 @@ class ServingEngine:
         )
         self._hbm_gauge = self.metrics.gauge(
             "serve/hbm_peak_bytes",
-            help="largest per-executable HBM peak across the serving pool",
+            help="largest per-executable HBM peak across the serving pool, "
+                 "per device (divided by the tp degree when sharded)",
         )
         self._accept_rate_gauge = self.metrics.gauge(
             "serve/spec_accept_rate",
@@ -479,6 +561,11 @@ class ServingEngine:
             help="info gauge: decode attention program — 1 = pallas "
                  "(in-place paged kernel), 0 = xla (gather reference)",
         ).set(1.0 if self.decode_kernel == "pallas" else 0.0)
+        self.metrics.gauge(
+            "serve/tp_degree",
+            help="info gauge: tensor-parallel degree the params and KV pool "
+                 "shard over (1 = single-chip)",
+        ).set(float(self.tp_degree))
         self._kv_quant_gauge = (
             self.metrics.gauge(
                 "serve/kv_quant_error",
@@ -494,6 +581,15 @@ class ServingEngine:
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
         self._counters[key].inc(n)
+
+    def _put(self, x):
+        """Upload host data for a window call.  Under a mesh every control
+        operand must be *replicated over the mesh's devices* — a plain
+        ``jnp.asarray`` commits to one device, which the explicitly-sharded
+        executables reject as an incompatible placement."""
+        if self._shardings is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._shardings.replicated)
 
     # ------------------------------------------------------------- submission
     def submit(
@@ -608,7 +704,9 @@ class ServingEngine:
                 if not self.paged:
                     # scratch restarts at position 0; stale KV beyond each new
                     # write is unreachable (causal mask == valid-entry mask)
-                    self.scratch = self.scratch.replace(index=jnp.zeros((), jnp.int32))
+                    self.scratch = self.scratch.replace(
+                        index=self._put(jnp.zeros((), jnp.int32))
+                    )
             if self.paged and not self._ensure_prefill_pages():
                 return  # page pressure: pause prefill, retry next step
             took = self.scheduler.take_chunk(budget)
@@ -703,10 +801,11 @@ class ServingEngine:
             raise RuntimeError("KV page pool exhausted mid-prefill")
         self.kv.lane_append_owned(s, ids)
         kv = self.kv
-        table = jnp.asarray(kv.tables[s])
+        table = self._put(kv.tables[s])
+        base = self._put(jnp.int32(start))
         if self.quantized:
             args = (self.params, chunk[None], kv.pages_k, kv.pages_v,
-                    kv.k_scales, kv.v_scales, table, jnp.int32(start))
+                    kv.k_scales, kv.v_scales, table, base)
             self.cost_table.capture(
                 f"serve/prefill_{bucket}", self._prefill[bucket], args,
             )
@@ -717,13 +816,11 @@ class ServingEngine:
             return
         self.cost_table.capture(
             f"serve/prefill_{bucket}", self._prefill[bucket],
-            (self.params, chunk[None], kv.pages_k, kv.pages_v, table,
-             jnp.int32(start)),
+            (self.params, chunk[None], kv.pages_k, kv.pages_v, table, base),
         )
         with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
             kv.pages_k, kv.pages_v = self._prefill[bucket](
-                self.params, chunk[None], kv.pages_k, kv.pages_v, table,
-                jnp.int32(start),
+                self.params, chunk[None], kv.pages_k, kv.pages_v, table, base,
             )
 
     def _reclaim_pages(self, need: int, allow_preempt: bool) -> bool:
@@ -860,7 +957,7 @@ class ServingEngine:
             with self.tracer.span("serve/copy_page", src=pid, dst=new[0]):
                 kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales = self._copy_page(
                     kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
-                    jnp.int32(pid), jnp.int32(new[0])
+                    self._put(jnp.int32(pid)), self._put(jnp.int32(new[0]))
                 )
             kv.lane_replace(s, pslot, new[0])
             self._bump("cow_copies")
@@ -879,13 +976,14 @@ class ServingEngine:
             self._cow_tail_page(s, plen)
             self._lane_len[s] = plen - 1
         else:
+            slot_i = self._put(jnp.int32(s))
+            length_i = self._put(jnp.int32(plen - 1))
             self.cost_table.capture(
                 "serve/insert", self._insert,
-                (self.pool, self.scratch.k, self.scratch.v, jnp.int32(s), jnp.int32(plen - 1)),
+                (self.pool, self.scratch.k, self.scratch.v, slot_i, length_i),
             )
             self.pool = self._insert(
-                self.pool, self.scratch.k, self.scratch.v,
-                jnp.int32(s), jnp.int32(plen - 1),
+                self.pool, self.scratch.k, self.scratch.v, slot_i, length_i,
             )
         self.recorder.record(
             "serve/install", rid=req.rid, slot=s, step=self._step_count,
@@ -932,12 +1030,12 @@ class ServingEngine:
         window's device-side outputs, so steady-state cycles upload nothing."""
         if self._lane_device is None:
             self._lane_device = [
-                jnp.asarray(self._pending_tok), jnp.asarray(self._active),
-                jnp.asarray(self._eos), jnp.asarray(self._do_sample),
-                jnp.asarray(self._temperature), jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
-                jnp.full((self.num_slots,), self.pad_token_id, jnp.int32),
-                jnp.asarray(self._rngs),
+                self._put(self._pending_tok), self._put(self._active),
+                self._put(self._eos), self._put(self._do_sample),
+                self._put(self._temperature), self._put(self._top_k),
+                self._put(self._top_p),
+                self._put(jnp.full((self.num_slots,), self.pad_token_id, jnp.int32)),
+                self._put(self._rngs),
             ]
         return self._lane_device
 
@@ -981,8 +1079,8 @@ class ServingEngine:
         lanes = self._lane_arrays()
         if self.paged and self._direct:
             kv = self.kv
-            tables = jnp.asarray(kv.tables)
-            index = jnp.asarray(self._lane_len)
+            tables = self._put(kv.tables)
+            index = self._put(self._lane_len)
             args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
                     kv.v_scales, tables, index, *lanes)
             if not self.cost_table.captured("serve/decode_window"):
@@ -999,8 +1097,8 @@ class ServingEngine:
             kv = self.kv
             # block tables + write indices ride up fresh each cycle (a few KB
             # of int32 — allocation is host-side and can change every cycle)
-            tables = jnp.asarray(kv.tables)
-            index = jnp.asarray(self._lane_len)
+            tables = self._put(kv.tables)
+            index = self._put(self._lane_len)
             if not self.cost_table.captured("serve/decode_window"):
                 self.cost_table.capture(
                     "serve/decode_window", self._decode,
@@ -1059,14 +1157,14 @@ class ServingEngine:
         lanes = self._lane_arrays()
         # the host pending mirror is always fresh (updated by _emit); only
         # the [N, K+1] token block uploads per verify cycle
-        tokens = jnp.asarray(
+        tokens = self._put(
             np.concatenate([self._pending_tok[:, None], drafts], axis=1)
         )
         n_drafted = int(drafted.sum())
         if self.paged and self._direct:
             kv = self.kv
-            tables = jnp.asarray(kv.tables)
-            index = jnp.asarray(self._lane_len)
+            tables = self._put(kv.tables)
+            index = self._put(self._lane_len)
             args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
                     kv.v_scales, tables, index, tokens, *lanes[1:])
             if not self.cost_table.captured("serve/verify_window"):
@@ -1083,8 +1181,8 @@ class ServingEngine:
                 self._kv_quant_gauge.set(float(jax.device_get(qerr)))
         elif self.paged:
             kv = self.kv
-            tables = jnp.asarray(kv.tables)
-            index = jnp.asarray(self._lane_len)
+            tables = self._put(kv.tables)
+            index = self._put(self._lane_len)
             if not self.cost_table.captured("serve/verify_window"):
                 self.cost_table.capture(
                     "serve/verify_window", self._verify,
@@ -1287,17 +1385,23 @@ class ServingEngine:
             )
         hbm = self.cost_table.max_hbm_peak_bytes()
         if hbm:
-            self._hbm_gauge.set(hbm)
+            # per-device: XLA's analysis sees logical (whole-array) shapes;
+            # under tp the KV pool and weights split evenly across the axis
+            self._hbm_gauge.set(hbm / self.tp_degree)
         return snap
 
     def kv_pool_bytes(self) -> int:
-        """Device HBM the KV state occupies: the whole page pool (paged — the
+        """PER-DEVICE HBM the KV state occupies: the page pool (paged — the
         knob ``num_pages`` sizes), or the slab pool plus the prefill scratch
-        (legacy).  The A/B bench holds this equal across both arms."""
+        (legacy).  Under a tp mesh the pool shards on the kv-head axis, so
+        each device holds exactly ``1 / tp_degree`` of the logical bytes —
+        the like-for-like number capacity benches compare.  The A/B bench
+        holds this equal across both arms."""
         if self.paged:
-            return self.kv.kv_bytes()
+            return self.kv.kv_bytes_per_device()
         return (int(self.pool.k.nbytes) + int(self.pool.v.nbytes)
-                + int(self.scratch.k.nbytes) + int(self.scratch.v.nbytes))
+                + int(self.scratch.k.nbytes)
+                + int(self.scratch.v.nbytes)) // self.tp_degree
 
     def compiled_executable_counts(self) -> dict:
         """Per-executable jit-cache sizes — the no-retrace contract: after any

@@ -144,7 +144,8 @@ class PagedKVPool:
 
     def __init__(self, config, num_slots: int, max_len: int, page_size: int,
                  num_pages: int, registry: Optional[MetricsRegistry] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None, mesh=None,
+                 tp_axis: str = "tp"):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size {page_size} "
@@ -166,15 +167,46 @@ class PagedKVPool:
         self.kv_dtype = kv_dtype
         self.storage_dtype = kv_storage_dtype(kv_dtype, cfg.dtype)
         self.quantized = kv_qmax(self.storage_dtype) is not None
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            from ..parallel.mesh import mesh_axis_size
+
+            self.tp_degree = mesh_axis_size(mesh, tp_axis)
+        else:
+            self.tp_degree = 1
+        if self.tp_degree > 1 and cfg.num_kv_heads % self.tp_degree != 0:
+            raise ValueError(
+                f"num_kv_heads {cfg.num_kv_heads} must divide evenly over "
+                f"tp={self.tp_degree} to shard the page pool on the head axis"
+            )
         shape = (cfg.num_layers, self.num_pages, self.page_size,
                  cfg.num_kv_heads, cfg.resolved_head_dim)
-        self.pages_k = jnp.zeros(shape, self.storage_dtype)
-        self.pages_v = jnp.zeros(shape, self.storage_dtype)
-        # per-(layer, page, kv-head) dequantization scales; ones (a no-op
-        # multiply the direct-store windows never read) when not quantized
         scale_shape = (cfg.num_layers, self.num_pages, cfg.num_kv_heads)
-        self.k_scales = jnp.ones(scale_shape, jnp.float32)
-        self.v_scales = jnp.ones(scale_shape, jnp.float32)
+        if mesh is not None:
+            # head-axis NamedSharding: each device holds Hkv/tp heads of every
+            # page.  Block tables / refcounts stay host-side and whole.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            ax = tp_axis if self.tp_degree > 1 else None
+            kv_sh = NamedSharding(mesh, PartitionSpec(None, None, None, ax, None))
+            sc_sh = NamedSharding(mesh, PartitionSpec(None, None, ax))
+            self.pages_k = jax.device_put(
+                jnp.zeros(shape, self.storage_dtype), kv_sh
+            )
+            self.pages_v = jax.device_put(
+                jnp.zeros(shape, self.storage_dtype), kv_sh
+            )
+            self.k_scales = jax.device_put(jnp.ones(scale_shape, jnp.float32), sc_sh)
+            self.v_scales = jax.device_put(jnp.ones(scale_shape, jnp.float32), sc_sh)
+        else:
+            self.pages_k = jnp.zeros(shape, self.storage_dtype)
+            self.pages_v = jnp.zeros(shape, self.storage_dtype)
+            # per-(layer, page, kv-head) dequantization scales; ones (a no-op
+            # multiply the direct-store windows never read) when not quantized
+            self.k_scales = jnp.ones(scale_shape, jnp.float32)
+            self.v_scales = jnp.ones(scale_shape, jnp.float32)
         #: bytes of k+v one page holds, scales included — the sharing/HBM
         #: accounting unit
         itemsize = jnp.zeros((), self.storage_dtype).itemsize
@@ -202,9 +234,10 @@ class PagedKVPool:
         )
         registry.gauge(
             "serve/kv_bytes_per_token",
-            help="KV HBM one token costs across all layers at the pool's "
-                 "storage dtype, amortized per-page scales included",
-        ).set(self.page_kv_bytes / self.page_size)
+            help="per-device KV HBM one token costs across all layers at the "
+                 "pool's storage dtype, amortized per-page scales included "
+                 "(the head axis divides exactly over tp when sharded)",
+        ).set(self.page_kv_bytes / self.page_size / self.tp_degree)
         self.publish_gauges()
 
     # -------------------------------------------------------------- lane ops
@@ -253,6 +286,11 @@ class PagedKVPool:
             int(self.pages_k.nbytes) + int(self.pages_v.nbytes)
             + int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
         )
+
+    def kv_bytes_per_device(self) -> int:
+        """Per-device share of :meth:`kv_bytes`: pages and scales both carry
+        the kv-head axis, which splits exactly over the tp degree."""
+        return self.kv_bytes() // self.tp_degree
 
     def publish_gauges(self) -> None:
         self._in_use_gauge.set(self.allocator.used_count)

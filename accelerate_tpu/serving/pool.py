@@ -45,16 +45,67 @@ counters.
 
 from __future__ import annotations
 
-import functools
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..models.generation import sample_tokens_batched
 from ..models.transformer import KVCache, PagedKVCache, Transformer
+from ..parallel.mesh import mesh_axis_size
 from ..utils.jax_compat import jit_cache_size
 from .paging import NULL_PAGE
+
+
+class ServeShardings:
+    """The engine's placement vocabulary under a tensor-parallel mesh.
+
+    Every serving executable moves arrays from exactly three families: KV
+    slabs/pools ``[L, *, *, Hkv, D]`` (sharded on the kv-head axis — dim 3 in
+    both the slab ``[L, N, max_len, H, D]`` and page ``[L, NP, page, H, D]``
+    layouts), per-page quantization scales ``[L, NP, Hkv]`` (head axis last),
+    and host-side control state (tokens, tables, indices, sampling knobs —
+    replicated).  Params carry the :data:`~accelerate_tpu.parallel
+    .tensor_parallel.DEFAULT_TP_RULES` placement computed by the engine.
+
+    Factories take ``shardings=None`` (single-chip, plain ``jax.jit``) or an
+    instance of this class, in which case every executable compiles with
+    explicit in/out shardings — donated KV buffers alias in place per shard,
+    and :mod:`tools.check_sharding_annotations` pins the discipline.
+    """
+
+    def __init__(self, mesh, params, tp_axis: str = "tp"):
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.tp_degree = mesh_axis_size(mesh, tp_axis)
+        ax = tp_axis if self.tp_degree > 1 else None
+        self.replicated = NamedSharding(mesh, PartitionSpec())
+        self.kv = NamedSharding(mesh, PartitionSpec(None, None, None, ax, None))
+        self.scales = NamedSharding(mesh, PartitionSpec(None, None, ax))
+        self.params = params
+
+    def rep(self, n: int) -> tuple:
+        """``n`` replicated placements — the control-state tail of a signature."""
+        return (self.replicated,) * n
+
+    def cache(self) -> KVCache:
+        """Placement pytree for a slab :class:`KVCache` (scratch or pool)."""
+        return KVCache(k=self.kv, v=self.kv, index=self.replicated)
+
+
+def _serve_jit(fn, *, donate_argnums=(), in_shardings=None, out_shardings=None):
+    """``jax.jit`` with optional explicit shardings.  ``None`` shardings mean
+    single-chip: compile without placement constraints (committed inputs keep
+    their devices, exactly the pre-mesh behavior)."""
+    if in_shardings is None and out_shardings is None:
+        return jax.jit(fn, donate_argnums=donate_argnums)  # noqa: sharding (single-chip)
+    return jax.jit(
+        fn,
+        donate_argnums=donate_argnums,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+    )
 
 
 def _decode_scan(model: Transformer, window: int, params, cache, tokens, active,
@@ -95,7 +146,8 @@ def _decode_scan(model: Transformer, window: int, params, cache, tokens, active,
     return cache, toks.T, tok, rngs
 
 
-def make_decode_window(model: Transformer, window: int):
+def make_decode_window(model: Transformer, window: int,
+                       shardings: Optional[ServeShardings] = None):
     """One jitted ``window``-step masked decode over the whole slot pool.
 
     ``(params, cache, tokens [N], active [N], eos [N], do_sample [N],
@@ -114,16 +166,22 @@ def make_decode_window(model: Transformer, window: int):
     ever overwrite their own dead slot, so running lanes are untouched.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
     def decode_window(params, cache, tokens, active, eos, do_sample, temperature,
                       top_k, top_p, pad, rngs):
         return _decode_scan(model, window, params, cache, tokens, active, eos,
                             do_sample, temperature, top_k, top_p, pad, rngs)
 
-    return decode_window
+    s = shardings
+    return _serve_jit(
+        decode_window,
+        donate_argnums=(1,),
+        in_shardings=None if s is None else (s.params, s.cache(), *s.rep(9)),
+        out_shardings=None if s is None else (s.cache(), *s.rep(3)),
+    )
 
 
-def make_verify_window(model: Transformer, k: int):
+def make_verify_window(model: Transformer, k: int,
+                       shardings: Optional[ServeShardings] = None):
     """One jitted speculative verify pass: K+1 positions per lane, one forward.
 
     ``(params, cache, tokens [N, K+1], active [N], eos [N], do_sample [N],
@@ -158,13 +216,18 @@ def make_verify_window(model: Transformer, k: int):
     is unreachable and gets overwritten by subsequent decode.  Frozen lanes
     (``~active``) commit nothing and keep their index.
     """
-    @functools.partial(jax.jit, donate_argnums=(1,))
     def verify_window(params, cache, tokens, active, eos, do_sample,
                       temperature, top_k, top_p, pad, rngs):
         return _verify_body(model, k, params, cache, tokens, active, eos,
                             do_sample, temperature, top_k, top_p, pad, rngs)
 
-    return verify_window
+    s = shardings
+    return _serve_jit(
+        verify_window,
+        donate_argnums=(1,),
+        in_shardings=None if s is None else (s.params, s.cache(), *s.rep(9)),
+        out_shardings=None if s is None else (s.cache(), *s.rep(4)),
+    )
 
 
 def _verify_body(model: Transformer, k: int, params, cache, tokens, active, eos,
@@ -239,7 +302,8 @@ def _verify_body(model: Transformer, k: int, params, cache, tokens, active, eos,
 
 
 
-def make_prefill_chunk(model: Transformer, chunk_len: int):
+def make_prefill_chunk(model: Transformer, chunk_len: int,
+                       shardings: Optional[ServeShardings] = None):
     """Jitted ``(params, tokens [1, chunk_len], scratch) -> scratch`` prefill.
 
     Writes the chunk's KV into the batch-1 scratch cache at
@@ -252,15 +316,20 @@ def make_prefill_chunk(model: Transformer, chunk_len: int):
     prompt token, so prefill and decode share one sampling path.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(2,))
     def prefill_chunk(params, tokens, scratch):
         _, scratch = model.apply({"params": params}, tokens, cache=scratch)
         return scratch
 
-    return prefill_chunk
+    s = shardings
+    return _serve_jit(
+        prefill_chunk,
+        donate_argnums=(2,),
+        in_shardings=None if s is None else (s.params, s.replicated, s.cache()),
+        out_shardings=None if s is None else s.cache(),
+    )
 
 
-def make_insert():
+def make_insert(shardings: Optional[ServeShardings] = None):
     """Jitted ``insert_request``: copy a prefilled scratch KV into a freed slot.
 
     ``(pool, scratch_k [L,1,Mp,H,D], scratch_v, slot, length) -> pool`` —
@@ -271,7 +340,6 @@ def make_insert():
     generated token through the same executable as every later token.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def insert_request(pool: KVCache, scratch_k, scratch_v, slot, length):
         k = jax.lax.dynamic_update_slice(
             pool.k, scratch_k.astype(pool.k.dtype), (0, slot, 0, 0, 0)
@@ -281,10 +349,17 @@ def make_insert():
         )
         return pool.replace(k=k, v=v, index=pool.index.at[slot].set(length))
 
-    return insert_request
+    s = shardings
+    return _serve_jit(
+        insert_request,
+        donate_argnums=(0,),
+        in_shardings=None if s is None else (s.cache(), s.kv, s.kv, *s.rep(2)),
+        out_shardings=None if s is None else s.cache(),
+    )
 
 
-def make_copy_chunk(chunk_len: int):
+def make_copy_chunk(chunk_len: int,
+                    shardings: Optional[ServeShardings] = None):
     """Jitted ``(scratch, slab_k, slab_v) -> scratch``: replay one cached chunk.
 
     The prefix-cache hit path: a retained KV slab ``[L, 1, chunk_len, H, D]``
@@ -296,7 +371,6 @@ def make_copy_chunk(chunk_len: int):
     prefill of this chunk would.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def copy_chunk(scratch: KVCache, slab_k, slab_v):
         k = jax.lax.dynamic_update_slice(
             scratch.k, slab_k.astype(scratch.k.dtype), (0, 0, scratch.index, 0, 0)
@@ -306,7 +380,13 @@ def make_copy_chunk(chunk_len: int):
         )
         return scratch.replace(k=k, v=v, index=scratch.index + chunk_len)
 
-    return copy_chunk
+    s = shardings
+    return _serve_jit(
+        copy_chunk,
+        donate_argnums=(0,),
+        in_shardings=None if s is None else (s.cache(), s.kv, s.kv),
+        out_shardings=None if s is None else s.cache(),
+    )
 
 
 # --------------------------------------------------------------------- paged
@@ -369,7 +449,8 @@ def _scatter_span(pages, view, tables, start, width: int, active):
 
 
 def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int,
-                             direct: bool = False):
+                             direct: bool = False,
+                             shardings: Optional[ServeShardings] = None):
     """Paged prefill: ``(params, tokens [1, chunk_len], pages_k, pages_v,
     table [P], base) -> (pages_k, pages_v)``.
 
@@ -393,9 +474,9 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int,
             f"chunk bucket {chunk_len} must be a multiple of page_size {page_size}"
         )
     npg = chunk_len // page_size
+    s = shardings
 
     if direct:
-        @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 5))
         def direct_prefill_chunk(params, tokens, pages_k, pages_v, k_scales,
                                  v_scales, table, base):
             cache = PagedKVCache(
@@ -408,9 +489,18 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int,
             return (cache.pages_k, cache.pages_v, cache.k_scales,
                     cache.v_scales, cache.quant_err)
 
-        return direct_prefill_chunk
+        return _serve_jit(
+            direct_prefill_chunk,
+            donate_argnums=(2, 3, 4, 5),
+            in_shardings=None if s is None else (
+                s.params, s.replicated, s.kv, s.kv, s.scales, s.scales,
+                *s.rep(2),
+            ),
+            out_shardings=None if s is None else (
+                s.kv, s.kv, s.scales, s.scales, s.replicated,
+            ),
+        )
 
-    @functools.partial(jax.jit, donate_argnums=(2, 3))
     def paged_prefill_chunk(params, tokens, pages_k, pages_v, table, base):
         L, _, page, H, D = pages_k.shape
         live = (base + chunk_len - 1) // page_size + 1
@@ -428,11 +518,19 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int,
         pages_v = pages_v.at[:, ids].set(wv.reshape(L, npg, page, H, D))
         return pages_k, pages_v
 
-    return paged_prefill_chunk
+    return _serve_jit(
+        paged_prefill_chunk,
+        donate_argnums=(2, 3),
+        in_shardings=None if s is None else (
+            s.params, s.replicated, s.kv, s.kv, *s.rep(2),
+        ),
+        out_shardings=None if s is None else (s.kv, s.kv),
+    )
 
 
 def make_paged_decode_window(model: Transformer, window: int,
-                             direct: bool = False):
+                             direct: bool = False,
+                             shardings: Optional[ServeShardings] = None):
     """Paged decode: ``(params, pages_k, pages_v, tables [N, P], index [N],
     tokens, active, eos, do_sample, temperature, top_k, top_p, pad, rngs)
     -> (pages_k, pages_v, out_tokens [N, window], new_pending, new_rngs)``.
@@ -453,8 +551,9 @@ def make_paged_decode_window(model: Transformer, window: int,
     new_pending, new_rngs, quant_err)``.
     """
 
+    s = shardings
+
     if direct:
-        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
         def direct_decode_window(params, pages_k, pages_v, k_scales, v_scales,
                                  tables, index, tokens, active, eos, do_sample,
                                  temperature, top_k, top_p, pad, rngs):
@@ -471,9 +570,17 @@ def make_paged_decode_window(model: Transformer, window: int,
             return (cache.pages_k, cache.pages_v, cache.k_scales,
                     cache.v_scales, toks, tok, rngs, cache.quant_err)
 
-        return direct_decode_window
+        return _serve_jit(
+            direct_decode_window,
+            donate_argnums=(1, 2, 3, 4),
+            in_shardings=None if s is None else (
+                s.params, s.kv, s.kv, s.scales, s.scales, *s.rep(11),
+            ),
+            out_shardings=None if s is None else (
+                s.kv, s.kv, s.scales, s.scales, *s.rep(4),
+            ),
+        )
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def paged_decode_window(params, pages_k, pages_v, tables, index, tokens,
                             active, eos, do_sample, temperature, top_k, top_p,
                             pad, rngs):
@@ -492,10 +599,16 @@ def make_paged_decode_window(model: Transformer, window: int,
         pages_v = _scatter_span(pages_v, cache.v, tables, index, window, active)
         return pages_k, pages_v, toks, tok, rngs
 
-    return paged_decode_window
+    return _serve_jit(
+        paged_decode_window,
+        donate_argnums=(1, 2),
+        in_shardings=None if s is None else (s.params, s.kv, s.kv, *s.rep(11)),
+        out_shardings=None if s is None else (s.kv, s.kv, *s.rep(3)),
+    )
 
 
-def make_paged_verify_window(model: Transformer, k: int, direct: bool = False):
+def make_paged_verify_window(model: Transformer, k: int, direct: bool = False,
+                             shardings: Optional[ServeShardings] = None):
     """Paged speculative verify: the slab :func:`_verify_body` over a gathered
     view, scattering all ``K+1`` written positions back (rejected positions'
     KV is unreachable past the committed index and gets overwritten later,
@@ -508,9 +621,9 @@ def make_paged_verify_window(model: Transformer, k: int, direct: bool = False):
     trailing ``quant_err``.
     """
     kp1 = k + 1
+    s = shardings
 
     if direct:
-        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
         def direct_verify_window(params, pages_k, pages_v, k_scales, v_scales,
                                  tables, index, tokens, active, eos, do_sample,
                                  temperature, top_k, top_p, pad, rngs):
@@ -528,9 +641,17 @@ def make_paged_verify_window(model: Transformer, k: int, direct: bool = False):
                     cache.v_scales, out, n_commit, new_pending, new_rngs,
                     cache.quant_err)
 
-        return direct_verify_window
+        return _serve_jit(
+            direct_verify_window,
+            donate_argnums=(1, 2, 3, 4),
+            in_shardings=None if s is None else (
+                s.params, s.kv, s.kv, s.scales, s.scales, *s.rep(11),
+            ),
+            out_shardings=None if s is None else (
+                s.kv, s.kv, s.scales, s.scales, *s.rep(5),
+            ),
+        )
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def paged_verify_window(params, pages_k, pages_v, tables, index, tokens,
                             active, eos, do_sample, temperature, top_k, top_p,
                             pad, rngs):
@@ -549,10 +670,15 @@ def make_paged_verify_window(model: Transformer, k: int, direct: bool = False):
         pages_v = _scatter_span(pages_v, cache.v, tables, index, kp1, active)
         return pages_k, pages_v, out, n_commit, new_pending, new_rngs
 
-    return paged_verify_window
+    return _serve_jit(
+        paged_verify_window,
+        donate_argnums=(1, 2),
+        in_shardings=None if s is None else (s.params, s.kv, s.kv, *s.rep(11)),
+        out_shardings=None if s is None else (s.kv, s.kv, *s.rep(4)),
+    )
 
 
-def make_copy_page():
+def make_copy_page(shardings: Optional[ServeShardings] = None):
     """Jitted copy-on-write: ``(pages_k, pages_v, k_scales, v_scales, src,
     dst) -> (pages_k, pages_v, k_scales, v_scales)`` duplicates one physical
     page (dequantization scales ride along — a quantized copy is exact, both
@@ -562,7 +688,6 @@ def make_copy_page():
     path.  One compiled shape per engine, page-size-static.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     def copy_page(pages_k, pages_v, k_scales, v_scales, src, dst):
         pages_k = pages_k.at[:, dst].set(pages_k[:, src])
         pages_v = pages_v.at[:, dst].set(pages_v[:, src])
@@ -570,7 +695,15 @@ def make_copy_page():
         v_scales = v_scales.at[:, dst].set(v_scales[:, src])
         return pages_k, pages_v, k_scales, v_scales
 
-    return copy_page
+    s = shardings
+    return _serve_jit(
+        copy_page,
+        donate_argnums=(0, 1, 2, 3),
+        in_shardings=None if s is None else (
+            s.kv, s.kv, s.scales, s.scales, *s.rep(2),
+        ),
+        out_shardings=None if s is None else (s.kv, s.kv, s.scales, s.scales),
+    )
 
 
 def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int], ...]:

@@ -1,0 +1,252 @@
+"""Prefix-affinity router over data-parallel :class:`ServingEngine` replicas.
+
+Tensor parallelism (``ServingEngine(mesh=...)``) makes one model span chips;
+this module scales the *other* direction: N independent engines — one per
+mesh slice (:func:`~accelerate_tpu.parallel.mesh.replica_meshes`) or per
+process — behind a single front door.  The routing decision is where the
+multi-chip win actually lands: each replica's prefix-cache radix tree holds
+the KV for the prefixes *it* has served, so a request routed to the replica
+that already holds its prefix replays cached KV instead of re-running
+prefill, while a random or round-robin placement scatters a shared prefix
+across every replica and pays the prefill everywhere (the reference's
+big-model dispatch layer routes to where the weights live; here the hot
+state is the prefix KV).
+
+Policy ``"affinity"`` (default): rolling-hash the prompt's leading chunks
+against each replica's radix tree (:meth:`PrefixCache.match` — a pure
+host-side walk, no device work, no pinning) and score each replica by the
+matched token count; the best positive scorer wins, load breaking ties, and
+zero-scorers fall back to least-loaded.  Policy ``"round_robin"`` is the
+baseline A/B arm (``bench_inference.py --task serve --tp-ab``).
+
+Failover: a replica that rejects a ``submit`` (capacity validation —
+e.g. heterogeneous ``max_len``) is skipped and the request tries the
+remaining replicas by load; the error propagates only when every replica
+refuses.
+
+Telemetry (``docs/usage/observability.md``): ``serve/replicas`` (info),
+``serve/router_affinity_hit_rate`` (fraction of routed requests whose chosen
+replica already held a matching prefix), and one ``serve/route`` flight
+event per submit carrying the chosen replica and its affinity score.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry import MetricsRegistry, get_flight_recorder, get_registry
+from .engine import ServingEngine
+from .pool import plan_chunks
+from .scheduler import Request
+
+_POLICIES = ("affinity", "round_robin")
+
+
+class ReplicaRouter:
+    """Route :meth:`submit` calls across N engine replicas; aggregate health.
+
+    Parameters
+    ----------
+    engines: the replicas.  Each owns its KV pool, scheduler, prefix cache,
+        and (optionally) its own tp mesh slice; the router never touches
+        device state — it only reads each replica's host-side radix tree and
+        queue depths.
+    policy: ``"affinity"`` (prefix-cache affinity, least-loaded fallback) or
+        ``"round_robin"`` (the A/B baseline).
+    registry: metrics registry for the router's gauges (defaults to the
+        process registry — pass the same private registry benches give their
+        engines to keep arms isolated).
+    """
+
+    def __init__(
+        self,
+        engines: Sequence[ServingEngine],
+        policy: str = "affinity",
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        if not engines:
+            raise ValueError("ReplicaRouter needs at least one engine")
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        self.engines: List[ServingEngine] = list(engines)
+        self.policy = policy
+        self.metrics = registry if registry is not None else get_registry()
+        self.recorder = get_flight_recorder()
+        self._rr_next = 0
+        self._routed = 0
+        self._affinity_hits = 0
+        self.metrics.gauge(
+            "serve/replicas",
+            help="info gauge: engine replicas behind the ReplicaRouter",
+        ).set(float(len(self.engines)))
+        self._affinity_gauge = self.metrics.gauge(
+            "serve/router_affinity_hit_rate",
+            help="fraction of routed requests whose chosen replica already "
+                 "held a matching prefix in its radix tree",
+        )
+
+    # ------------------------------------------------------------- placement
+    def _load(self, engine: ServingEngine) -> int:
+        """Host-side load proxy: queued + mid-prefill + active lanes."""
+        sched = engine.scheduler
+        return (
+            len(sched.queue)
+            + (sched.prefilling is not None)
+            + int(engine._active.sum())
+        )
+
+    def _affinity(self, engine: ServingEngine, prompt: np.ndarray) -> int:
+        """Tokens of ``prompt`` this replica's radix tree already holds —
+        a read-only walk over full leading chunks (LRU touch only; nothing
+        is pinned until the engine's own admission runs)."""
+        if engine.prefix_cache is None:
+            return 0
+        chunks = plan_chunks(len(prompt), engine.buckets)
+        nodes = engine.prefix_cache.match(prompt, chunks)
+        return sum(len(n.tokens) for n in nodes)
+
+    def _choose(self, prompt: np.ndarray) -> tuple:
+        """``(replica_index, affinity_score)`` under the configured policy."""
+        if self.policy == "round_robin":
+            i = self._rr_next % len(self.engines)
+            self._rr_next += 1
+            return i, 0
+        scores = [self._affinity(e, prompt) for e in self.engines]
+        best = max(scores)
+        if best > 0:
+            # highest score wins; load breaks ties among equals
+            tied = [i for i, sc in enumerate(scores) if sc == best]
+            i = min(tied, key=lambda i: self._load(self.engines[i]))
+            return i, best
+        i = min(range(len(self.engines)), key=lambda i: self._load(self.engines[i]))
+        return i, 0
+
+    # ------------------------------------------------------------ submission
+    def submit(
+        self,
+        prompt,
+        config=None,
+        on_token: Optional[Callable[[Request, int], None]] = None,
+        **kwargs: Any,
+    ) -> Request:
+        """Route one request to a replica and queue it there.  The returned
+        :class:`Request` carries ``replica`` — the index it landed on — so
+        callers can drive or cancel against the right engine."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        idx, score = self._choose(prompt)
+        # failover ladder: chosen replica first, then the rest by load
+        order = [idx] + sorted(
+            (i for i in range(len(self.engines)) if i != idx),
+            key=lambda i: self._load(self.engines[i]),
+        )
+        last_err: Optional[Exception] = None
+        for n_try, i in enumerate(order):
+            try:
+                req = self.engines[i].submit(
+                    prompt, config=config, on_token=on_token, **kwargs
+                )
+            except ValueError as exc:
+                last_err = exc
+                continue
+            req.replica = i
+            self._routed += 1
+            if i == idx and score > 0:
+                self._affinity_hits += 1
+            self._affinity_gauge.set(self._affinity_hits / self._routed)
+            self.recorder.record(
+                "serve/route", rid=req.rid, replica=i, affinity=int(score),
+                policy=self.policy, failover=n_try,
+            )
+            return req
+        raise last_err  # every replica refused; surface the final reason
+
+    def cancel(self, request) -> bool:
+        """Cancel on whichever replica holds the request."""
+        engines = (
+            [self.engines[request.replica]]
+            if getattr(request, "replica", None) is not None
+            else self.engines
+        )
+        return any(e.cancel(request) for e in engines)
+
+    # ----------------------------------------------------------------- drive
+    @property
+    def has_work(self) -> bool:
+        return any(e.has_work for e in self.engines)
+
+    def step(self) -> None:
+        """One iteration of every replica that has work (round-robin drive —
+        in production each replica runs its own host loop/process; this
+        single-threaded drive is what tests and benches use)."""
+        for e in self.engines:
+            if e.has_work:
+                e.step()
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(f"router did not drain in {max_steps} steps")
+
+    def serve(self, prompts: Sequence, configs=None) -> List[Request]:
+        """Submit every prompt through the router, drain all replicas, return
+        the requests in submission order."""
+        reqs = []
+        for i, p in enumerate(prompts):
+            cfg = configs[i] if isinstance(configs, (list, tuple)) else configs
+            reqs.append(self.submit(p, config=cfg))
+        self.run()
+        return reqs
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """Sum of every replica's ``stats`` dict, plus router counters."""
+        out: dict = {}
+        for e in self.engines:
+            for k, v in e.stats.items():
+                out[k] = out.get(k, 0) + v
+        out["routed"] = self._routed
+        out["affinity_hits"] = self._affinity_hits
+        return out
+
+    def prefix_cache_stats(self) -> dict:
+        """Aggregate prefix-cache health across replicas (token-weighted
+        hit rate — the router A/B's headline number)."""
+        hit = sum(e.stats["prefix_hit_tokens"] for e in self.engines)
+        miss = sum(e.stats["prefix_miss_tokens"] for e in self.engines)
+        covered = hit + miss
+        return {
+            "prefix_hit_tokens": hit,
+            "prefix_miss_tokens": miss,
+            "hit_rate": hit / covered if covered else 0.0,
+            "per_replica": [e.prefix_cache_stats() for e in self.engines],
+        }
+
+    def health(self) -> dict:
+        """One snapshot a front door can poll: per-replica queue/occupancy
+        plus the router's routing counters."""
+        return {
+            "replicas": len(self.engines),
+            "policy": self.policy,
+            "routed": self._routed,
+            "affinity_hit_rate": (
+                self._affinity_hits / self._routed if self._routed else 0.0
+            ),
+            "per_replica": [
+                {
+                    "queue_depth": len(e.scheduler.queue)
+                    + (e.scheduler.prefilling is not None),
+                    "active_lanes": int(e._active.sum()),
+                    "tp_degree": e.tp_degree,
+                    "has_work": e.has_work,
+                }
+                for e in self.engines
+            ],
+        }
+
+
+__all__ = ["ReplicaRouter"]

@@ -78,6 +78,9 @@ class Request:
     # wall-clock stamps (time.perf_counter) for TTFT / per-token latency
     submit_time: float = 0.0
     last_token_time: float = 0.0
+    # replica index a :class:`~accelerate_tpu.serving.router.ReplicaRouter`
+    # placed this request on (None when submitted straight to an engine)
+    replica: Optional[int] = None
 
     @property
     def done(self) -> bool:

@@ -416,6 +416,225 @@ def _paged_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _tp_ab_bench(args, model, cfg, params, preset):
+    """Tensor-parallel serving A/B: tp=2 vs tp=1, then router affinity vs
+    round-robin — the multi-chip serve entry (MULTICHIP_r06).
+
+    Arm 1/2 (tp identity): the SAME engine, workload, and request stream on a
+    single chip and on a ``{"tp": 2}`` mesh (params column-parallel under
+    ``SERVING_TP_RULES``, KV pool head-sharded).  Hard checks, each a
+    nonzero exit:
+
+    * greedy outputs token-identical between the arms (SERVING_TP_RULES
+      shard no contraction, so sharded reductions run in the tp=1 order);
+    * per-device KV pool bytes at tp=2 at most 55% of tp=1 — the whole point
+      of sharding the pool;
+    * ``compiled_executable_counts()`` identical — the mesh must not cost
+      executables, only shard the existing ones.
+
+    The identity arms run in float32 (prompts and params recast) for the same
+    reason ``tests/test_serving.py`` does: token-exactness needs full-precision
+    argmax margins, not bf16 ties.
+
+    Arm 3/4 (router A/B): two engine replicas behind a
+    :class:`~accelerate_tpu.serving.ReplicaRouter`, a shared-prefix workload
+    submitted in waves (each wave drains before the next arrives, so the
+    radix trees the router probes reflect served traffic).  The affinity
+    policy must beat round-robin on the aggregate token-weighted prefix-hit
+    rate — strictly, or the bench exits nonzero.
+
+    Needs >= 2 devices; on a 1-device host it self-provisions the 8-fake-CPU
+    mesh in a subprocess, mirroring ``__graft_entry__.dryrun_multichip``.
+    """
+    import subprocess
+    import sys
+
+    if len(jax.devices()) < 2:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env=env
+        )
+        raise SystemExit(proc.returncode)
+
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.models.transformer import Transformer
+    from accelerate_tpu.parallel.mesh import build_mesh
+    from accelerate_tpu.serving import ReplicaRouter, ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), params)
+    )
+    window = args.decode_window
+    mp = max(16, min(args.seq, cfg.max_seq_len) // 2)
+    buckets = (max(8, mp // 4), max(8, mp // 2))
+    max_len = min(cfg.max_seq_len, 2 * mp)
+
+    r = np.random.default_rng(args.serve_seed)
+    n = args.requests
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(4, mp // 3)), 0.6, n)), 4, mp
+    ).astype(int)
+    prompts = [
+        r.integers(1, cfg.vocab_size, (int(p),)).astype(np.int32)
+        for p in prompt_lens
+    ]
+    out_cap = max(window, (max_len - mp - window) // 2)
+    out_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(window, out_cap // 2)), 0.6, n)),
+        window, out_cap,
+    ).astype(int)
+    gens = [GenerationConfig(max_new_tokens=int(o)) for o in out_lens]
+    useful_tokens = int(out_lens.sum())
+
+    def run_arm(mesh):
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            model, params, num_slots=args.batch, max_len=max_len,
+            max_prompt_len=mp, prefill_buckets=buckets, decode_window=window,
+            registry=registry, prefix_cache_mb=0, paged=True, mesh=mesh,
+        )
+        warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets]
+        eng.serve(warm, GenerationConfig(max_new_tokens=window))
+        for k in eng.stats:
+            eng.stats[k] = 0
+        registry.reset()
+        t0 = time.perf_counter()
+        reqs = eng.serve(prompts, gens)
+        dt = time.perf_counter() - t0
+        return eng, reqs, dt
+
+    mesh2 = build_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng1, reqs1, dt1 = run_arm(None)
+    eng2, reqs2, dt2 = run_arm(mesh2)
+    if [q.tokens for q in reqs1] != [q.tokens for q in reqs2]:
+        raise SystemExit(
+            "tensor-parallel serving changed greedy outputs: tp=2 tokens "
+            "differ from tp=1 on the same workload"
+        )
+    bytes1, bytes2 = eng1.kv_pool_bytes(), eng2.kv_pool_bytes()
+    if bytes2 > 0.55 * bytes1:
+        raise SystemExit(
+            f"tp=2 per-device KV pool holds {bytes2} bytes vs {bytes1} at "
+            "tp=1 — sharding the pool on the head axis must at least halve it"
+        )
+    counts1 = eng1.compiled_executable_counts()
+    counts2 = eng2.compiled_executable_counts()
+    if counts1 != counts2:
+        raise SystemExit(
+            f"mesh changed the compiled-executable budget: tp=1 {counts1} "
+            f"vs tp=2 {counts2}"
+        )
+
+    # ---- router A/B: shared-prefix waves, affinity vs round-robin --------
+    # 3 prefix groups over 2 replicas: coprime, so round-robin rotates each
+    # group across replicas wave over wave (repaying the prefill everywhere)
+    # while affinity pins each group to the replica that first served it
+    n_groups, n_waves = 3, 5
+    shared = buckets[1]
+    commons = [
+        r.integers(1, cfg.vocab_size, (shared,)).astype(np.int32)
+        for _ in range(n_groups)
+    ]
+    waves = []
+    for _ in range(n_waves):
+        wave = []
+        for c in commons:
+            sfx = r.integers(1, cfg.vocab_size, (int(r.integers(4, 12)),))
+            wave.append(np.concatenate([c, sfx.astype(np.int32)]))
+        waves.append(wave)
+    router_gen = GenerationConfig(max_new_tokens=window)
+
+    def run_router(policy):
+        registry = MetricsRegistry()
+        engines = [
+            ServingEngine(
+                model, params, num_slots=args.batch, max_len=max_len,
+                max_prompt_len=mp, prefill_buckets=buckets,
+                decode_window=window, registry=MetricsRegistry(),
+                prefix_cache_mb=args.prefix_cache_mb, paged=True,
+            )
+            for _ in range(2)
+        ]
+        router = ReplicaRouter(engines, policy=policy, registry=registry)
+        for wave in waves:
+            for p in wave:
+                router.submit(p, config=router_gen)
+            router.run()
+        return router
+
+    router_aff = run_router("affinity")
+    router_rr = run_router("round_robin")
+    hit_aff = router_aff.prefix_cache_stats()["hit_rate"]
+    hit_rr = router_rr.prefix_cache_stats()["hit_rate"]
+    if not hit_aff > hit_rr:
+        raise SystemExit(
+            f"prefix-affinity routing found no more cached tokens than "
+            f"round-robin ({hit_aff:.3f} vs {hit_rr:.3f}) on a shared-prefix "
+            "workload it was built for"
+        )
+
+    n_dev = len(jax.devices())
+    tail = (
+        f"serve_tp_ab({n_dev}): mesh={{'tp': 2}} token_identical=True "
+        f"kv_per_device_ratio={bytes2 / bytes1:.2f} "
+        f"router_hit affinity={hit_aff:.3f} > round_robin={hit_rr:.3f} OK"
+    )
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "MULTICHIP_r06.json"), "w") as f:
+        json.dump({"n_devices": n_dev, "rc": 0, "ok": True,
+                   "skipped": False, "tail": tail}, f)
+
+    def arm_detail(eng, dt):
+        return {
+            "kv_pool_bytes_per_device": eng.kv_pool_bytes(),
+            "tp_degree": eng.tp_degree,
+            "wall_s": round(dt, 3),
+            "tokens_per_s": round(useful_tokens / dt, 2),
+            "compiled_executables": eng.compiled_executable_counts(),
+        }
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "n_devices": n_dev,
+        "requests": n,
+        "decode_window": window,
+        "prefill_buckets": list(buckets),
+        "max_len": max_len,
+        "useful_tokens": useful_tokens,
+        "outputs_token_identical": True,
+        "tp1": arm_detail(eng1, dt1),
+        "tp2": arm_detail(eng2, dt2),
+        "router": {
+            "replicas": 2,
+            "waves": n_waves,
+            "prefix_groups": n_groups,
+            "shared_prefix": int(shared),
+            "affinity_hit_rate": round(hit_aff, 4),
+            "round_robin_hit_rate": round(hit_rr, 4),
+            "affinity_routed_hits": router_aff.health()["affinity_hit_rate"],
+        },
+    }
+    return {
+        "metric": "serving_tp_kv_per_device_ratio",
+        "value": round(bytes2 / bytes1, 3),
+        "unit": "x",
+        "vs_baseline": round((useful_tokens / dt2) / (useful_tokens / dt1), 3),
+        "detail": detail,
+    }
+
+
 def _quantized_logit_divergence(model, cfg, params, seq, plen, page, kv_dtype):
     """True logit-divergence oracle for quantized KV pages.
 
@@ -693,13 +912,16 @@ def _serve_bench(args, model, cfg, params, preset):
     """
     if sum([bool(getattr(args, "paged_ab", False)),
             bool(getattr(args, "kernel_ab", False)),
+            bool(getattr(args, "tp_ab", False)),
             bool(args.shared_prefix)]) > 1:
-        raise SystemExit("--paged-ab, --kernel-ab and --shared-prefix are "
-                         "separate serve workloads; pick one")
+        raise SystemExit("--paged-ab, --kernel-ab, --tp-ab and --shared-prefix "
+                         "are separate serve workloads; pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "kernel_ab", False):
         return _kernel_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "tp_ab", False):
+        return _tp_ab_bench(args, model, cfg, params, preset)
 
     from accelerate_tpu.models.generation import GenerationConfig, generate
     from accelerate_tpu.serving import ServingEngine
@@ -893,6 +1115,12 @@ def main():
                              "the paged engine (xla vs pallas, native vs "
                              "--kv-dtype) — token-identity and logit-divergence "
                              "hard checks, plus a byte-equal capacity probe")
+    parser.add_argument("--tp-ab", dest="tp_ab", action="store_true",
+                        help="--task serve: multi-chip A/B — tp=2 vs tp=1 "
+                             "(token-identity, per-device KV bytes, and "
+                             "executable-budget hard checks) plus router "
+                             "affinity vs round-robin on a shared-prefix "
+                             "workload; writes MULTICHIP_r06.json on success")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",
                         help="--kernel-ab: quantized KV page format for the "

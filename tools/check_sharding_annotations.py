@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Lint: every jit in the serving package threads explicit shardings.
+
+Serving executables are compiled once and reused across thousands of steps;
+a ``jax.jit``/``pjit`` without ``in_shardings``/``out_shardings`` leaves
+placement to GSPMD's propagation pass, which is free to pick a layout that
+silently diverges from the head-sharded KV pool (a resharding collective in
+the decode loop, or worse, a replicated pool that quietly undoes the tp
+memory win).  So inside ``accelerate_tpu/serving/`` every ``jax.jit`` /
+``jax.pjit`` / bare ``jit(...)`` call must pass at least one of the
+``in_shardings`` / ``out_shardings`` keywords — in practice by going through
+``pool._serve_jit``, which threads both or documents why not.
+
+A call that is intentionally unconstrained carries a ``# noqa: sharding``
+pragma on its line (with a reason, by convention).  Decorator usage
+(``@jax.jit``) is a call node too and is checked the same way.
+
+Exit status 1 with one ``path:line`` diagnostic per violation; 0 when clean.
+Wired into ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "accelerate_tpu" / "serving"
+JIT_NAMES = ("jit", "pjit")
+SHARDING_KWARGS = ("in_shardings", "out_shardings")
+PRAGMA = "noqa: sharding"
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute):  # jax.jit / jax.experimental.pjit.pjit
+        return func.attr in JIT_NAMES
+    if isinstance(func, ast.Name):  # from jax import jit
+        return func.id in JIT_NAMES
+    return False
+
+
+def unannotated_jits(path: Path) -> list:
+    """``lineno`` for every jit call missing explicit sharding keywords."""
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:  # quality target also runs compileall; be loud
+        print(f"{path}:{exc.lineno}: syntax error: {exc.msg}")
+        sys.exit(1)
+    src_lines = source.splitlines()
+    found = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and _is_jit_call(node)
+            and not any(kw.arg in SHARDING_KWARGS for kw in node.keywords)
+            and PRAGMA not in src_lines[node.lineno - 1]
+        ):
+            found.append(node.lineno)
+    return found
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        for lineno in unannotated_jits(path):
+            rel = path.relative_to(REPO_ROOT)
+            violations.append(
+                f"{rel}:{lineno}: jit without in_shardings/out_shardings — "
+                f"route it through pool._serve_jit or add '# {PRAGMA}' with "
+                "a reason"
+            )
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_sharding_annotations: {len(violations)} violation(s)")
+        return 1
+    print("check_sharding_annotations: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
