@@ -52,12 +52,14 @@ bench-serve:
 	python bench_inference.py --task serve --paged-ab
 	python bench_inference.py --task serve --kernel-ab
 	python bench_inference.py --task serve --tp-ab
+	python bench_inference.py --task serve --async-ab
 	python bench_inference.py --task spec
 
 quality:
 	python -m compileall -q accelerate_tpu
 	python tools/check_reference_citations.py
 	python tools/check_no_bare_print.py
+	python tools/check_no_blocking_readback.py
 	python tools/check_no_method_lru_cache.py
 	python tools/check_pallas_interpret.py
 	python tools/check_metric_docs.py

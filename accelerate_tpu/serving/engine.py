@@ -61,11 +61,13 @@ from ..telemetry import (
 from .paging import PagedKVPool
 from .pool import (
     ServeShardings,
+    audit_donation,
     jit_cache_sizes,
     make_copy_chunk,
     make_copy_page,
     make_decode_window,
     make_insert,
+    make_lane_install,
     make_paged_decode_window,
     make_paged_prefill_chunk,
     make_paged_verify_window,
@@ -74,6 +76,7 @@ from .pool import (
     plan_chunks,
 )
 from .prefix_cache import PrefixCache
+from .readback import Readback, fetch
 from .scheduler import Request, RequestState, Scheduler
 from .spec import propose_ngram_draft
 
@@ -184,6 +187,25 @@ class ServingEngine:
         Head counts must divide the tp degree.
     tp_axis: mesh axis name the KV heads and weight matrices shard over
         (default ``"tp"``); axes absent from the mesh count as size 1.
+    async_depth: ``1`` (the default) runs the depth-1 pipelined loop: each
+        decode window's tokens stay on device in a :class:`.readback.Readback`
+        handle while the host runs ``_emit``, streaming callbacks, and the
+        next step's admission, and the NEXT window is dispatched before the
+        previous one's tokens are materialized — host work overlaps device
+        compute instead of alternating with it.  Outputs are token-identical
+        to ``async_depth=0`` (today's strictly synchronous loop) for every
+        sampling mode; the observable differences are lag semantics only: a
+        lane that hits EOS at window N is retired one cycle later (it may
+        execute one extra masked window whose tokens are discarded — written
+        to the null page in paged mode, overwritten-before-read in the slab),
+        ``finish_step`` lands one step later, and ``cancel`` of a running
+        lane drops the in-flight window's tokens.  Speculative cycles
+        synchronize on the previous window before dispatching (drafts and the
+        verify token block need its tokens), so with ``speculate_k > 0`` the
+        overlap covers scheduling/admission but not ``_emit``.  Set
+        ``async_depth=0`` when callbacks must observe tokens the same step
+        the device produced them, or to bisect a suspected pipelining bug.
+        See ``docs/usage/serving.md`` ("Async pipelined serving").
     """
 
     def __init__(
@@ -211,6 +233,7 @@ class ServingEngine:
         kv_dtype: Optional[str] = None,
         mesh=None,
         tp_axis: str = "tp",
+        async_depth: int = 1,
     ):
         cfg = model.config
         self.model = model
@@ -248,6 +271,15 @@ class ServingEngine:
             raise ValueError(
                 f"slot_order must permute range({self.num_slots}), got {self.slot_order}"
             )
+        self.async_depth = int(async_depth)
+        if self.async_depth not in (0, 1):
+            raise ValueError(
+                f"async_depth must be 0 (synchronous) or 1 (depth-1 pipeline), "
+                f"got {async_depth}"
+            )
+        #: the at-most-one in-flight window handle (depth-1 pipeline); None
+        #: when the pipeline is empty (always, under async_depth=0)
+        self._inflight: Optional[Readback] = None
 
         self.paged = bool(paged)
         if decode_kernel not in ("xla", "pallas"):
@@ -419,6 +451,10 @@ class ServingEngine:
                 budget=1, registry=self.metrics
             )
         )
+        self._lane_install = RecompileWatchdog(
+            make_lane_install(shardings=self._shardings),
+            name="serve/lane_install", budget=1, registry=self.metrics,
+        )
         self._verify = (
             RecompileWatchdog(
                 make_paged_verify_window(
@@ -495,9 +531,10 @@ class ServingEngine:
         self.peak_active_lanes = 0
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._reserved_slot: Optional[int] = None
-        # device-resident mirror of the lane vectors above (uploaded lazily,
-        # invalidated only on admit/free) — steady-state decode/verify cycles
-        # ship zero lane-state host->device traffic
+        # device-resident mirror of the lane vectors above (uploaded once,
+        # then edited in place: decode/verify carry pending/rng device-side,
+        # installs scatter one slot, frees re-upload the active mask) —
+        # lane state never round-trips through the host mid-serve
         self._lane_device: Optional[list] = None
 
         self._next_rid = 0
@@ -520,6 +557,7 @@ class ServingEngine:
             "spec_accepted": 0,
             "preemptions": 0,
             "cow_copies": 0,
+            "prefreed_lanes": 0,
         }
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
@@ -577,6 +615,41 @@ class ServingEngine:
             if self.quantized
             else None
         )
+        # pipeline overlap accounting (async_depth=1): host_s accumulates the
+        # dispatch->drain host-work time each window, wait_s the blocking tail
+        # of each fetch; their ratio is the fraction of host work the device
+        # covered.  _t_pipeline_empty timestamps the moment the pipeline went
+        # empty so the next dispatch can charge the gap as device idle — under
+        # async_depth=0 that is every host gap (the honest baseline number),
+        # at steady depth-1 state it stays ~0.
+        self._overlap_host_s = 0.0
+        self._overlap_wait_s = 0.0
+        self._device_idle_s = 0.0
+        self._t_pipeline_empty: Optional[float] = None
+        # set when a lane is freed while its window is still in flight: the
+        # active mask is host-authoritative, so the next dispatch refreshes
+        # just that one device vector instead of a full (blocking) resync
+        self._mask_stale = False
+        # old device handles replaced by a lane-install scatter or a mask
+        # re-upload while a window is in flight.  They must not be *dropped*
+        # yet — releasing the last reference to a handle a pending
+        # computation consumes blocks until that computation finishes — so
+        # they stage here and ride out on the next window's Readback, dying
+        # only after its drain.
+        self._stale_handles: List = []
+        self._overlap_gauge = self.metrics.gauge(
+            "serve/host_overlap_ratio",
+            help="fraction of serve-loop host work (emit/callbacks/admission) "
+                 "hidden under device execution: host_s / (host_s + "
+                 "readback_wait_s), cumulative; 0 under async_depth=0",
+        )
+        self._idle_gauge = self.metrics.gauge(
+            "serve/device_idle_ms",
+            help="cumulative ms the device sat with no window dispatched or "
+                 "in flight (pipeline-empty gaps between drain and the next "
+                 "dispatch); grows every step under async_depth=0, stays "
+                 "near-flat once the depth-1 pipeline fills",
+        )
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
@@ -586,7 +659,16 @@ class ServingEngine:
         """Upload host data for a window call.  Under a mesh every control
         operand must be *replicated over the mesh's devices* — a plain
         ``jnp.asarray`` commits to one device, which the explicitly-sharded
-        executables reject as an incompatible placement."""
+        executables reject as an incompatible placement.
+
+        numpy inputs are copied first: the host mirrors (``_active``,
+        ``_lane_len``, the paged block tables) stay mutable while a window
+        is in flight, and CPU ``device_put`` may alias an aligned numpy
+        buffer zero-copy — without the copy, a post-dispatch host mutation
+        (lane retirement, ``_lane_len`` advance, ``lane_detach`` nulling a
+        table row) could be read mid-execution by the in-flight window."""
+        if isinstance(x, np.ndarray):
+            x = x.copy()
         if self._shardings is None:
             return jnp.asarray(x)
         return jax.device_put(jnp.asarray(x), self._shardings.replicated)
@@ -667,12 +749,10 @@ class ServingEngine:
             req = self._slot_req[s]
             if req is None or req.rid != rid or not self._active[s]:
                 continue
-            self._lane_mark_dirty()
-            self._active[s] = False
-            self._slot_req[s] = None
-            if self.paged:
-                self.kv.lane_release(s)
-                self._lane_len[s] = 0
+            # with a window in flight the lane's tokens from that window are
+            # dropped at drain (ownership check in _emit); its KV pages stay
+            # held until the window retires (lane_detach deferral)
+            self._retire_lane(s)
             req.state = RequestState.CANCELLED
             req.finish_step = self._step_count
             self._bump("cancelled")
@@ -685,6 +765,11 @@ class ServingEngine:
 
     # -------------------------------------------------------------- admission
     def _next_free_slot(self) -> Optional[int]:
+        # a lane freed while its window is still in flight is immediately
+        # admissible: the host mask/slot_req are authoritative (the stale
+        # device mask only costs the dead lane one extra masked window), and
+        # in-flight writes to the slot are overwritten by insert/prefill,
+        # which queue behind the window on device
         for s in self.slot_order:
             if not self._active[s] and self._slot_req[s] is None and s != self._reserved_slot:
                 return s
@@ -812,7 +897,7 @@ class ServingEngine:
             with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
                 (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
                  qerr) = self._prefill[bucket](*args)
-            self._kv_quant_gauge.set(float(jax.device_get(qerr)))
+            self._kv_quant_gauge.set(float(fetch(qerr)))
             return
         self.cost_table.capture(
             f"serve/prefill_{bucket}", self._prefill[bucket],
@@ -827,12 +912,18 @@ class ServingEngine:
         """Recover free pages until at least ``need`` are available.  The
         ladder, cheapest first: (1) evict unpinned prefix-cache leaves —
         dropping the cache's reference frees any page no lane still aliases;
-        (2) preempt the youngest running lane (its pages free NOW; it requeues
-        at the front and replays through the cache); (3) strip queued
-        requests' cache pins so step 1 can reach more leaves.  Returns False
-        when the ladder is exhausted short of ``need``."""
+        (2) drain the in-flight window so pages parked on its deferral list
+        (lanes freed/preempted after it dispatched) return to the pool — one
+        pipeline sync, but nothing running is sacrificed; (3) preempt the
+        youngest running lane (its pages free NOW; it requeues at the front
+        and replays through the cache); (4) strip queued requests' cache pins
+        so step 1 can reach more leaves.  Returns False when the ladder is
+        exhausted short of ``need``."""
         while self.kv.allocator.free_count < need:
             if self.prefix_cache is not None and self.prefix_cache.evict_one():
+                continue
+            if self._inflight is not None and self._inflight.deferred_pages:
+                self._drain_inflight()
                 continue
             if allow_preempt and self._preempt():
                 continue
@@ -860,11 +951,9 @@ class ServingEngine:
             padded = sum(b for b, _ in plan_chunks(eff, self.buckets))
             if eff > self.max_prompt_len or padded > self.max_len:
                 continue  # grew past replayability (max_prompt_len < max_len)
-            self._lane_mark_dirty()
-            self._active[s] = False
-            self._slot_req[s] = None
-            freed = self.kv.lane_release(s)
-            self._lane_len[s] = 0
+            # tokens the in-flight window lands for the victim are dropped at
+            # drain and regenerated by the replay (token-exact under greedy)
+            freed = self._retire_lane(s)
             self.scheduler.requeue(req)
             self._bump("preemptions")
             self.recorder.record(
@@ -990,15 +1079,39 @@ class ServingEngine:
             prompt_len=plen,
         )
         gen = req.config
-        self._lane_mark_dirty()
+        rng = np.asarray(jax.random.fold_in(self._base_rng, req.rid), np.uint32)
+        eos_v = -1 if gen.eos_token_id is None else gen.eos_token_id
+        top_k_v = 0 if gen.top_k is None else gen.top_k
+        top_p_v = 1.0 if gen.top_p is None else gen.top_p
+        if self._lane_device is not None:
+            # Admission must not sync the pipeline: pending/rng are carried
+            # on device between windows, and fetching them here would block
+            # on the in-flight window.  A one-slot device-side scatter edits
+            # the carried vectors instead — it enqueues behind the in-flight
+            # window and costs the host only a dispatch.
+            ld = self._lane_device
+            # the replaced handles are inputs of the scatter (and outputs of
+            # the in-flight window): park them until the next drain so their
+            # destructors never wait on pending device work
+            self._stale_handles += [ld[0], ld[1], ld[2], ld[3], ld[4],
+                                    ld[5], ld[6], ld[8]]
+            (ld[0], ld[1], ld[2], ld[3], ld[4], ld[5], ld[6],
+             ld[8]) = self._lane_install(
+                ld[0], ld[1], ld[2], ld[3], ld[4], ld[5], ld[6], ld[8],
+                self._put(np.int32(s)), self._put(np.int32(ptoks[-1])),
+                self._put(np.int32(eos_v)), self._put(np.bool_(gen.do_sample)),
+                self._put(np.float32(gen.temperature)),
+                self._put(np.int32(top_k_v)), self._put(np.float32(top_p_v)),
+                self._put(rng),
+            )
         self._pending_tok[s] = ptoks[-1]
         self._active[s] = True
-        self._eos[s] = -1 if gen.eos_token_id is None else gen.eos_token_id
+        self._eos[s] = eos_v
         self._do_sample[s] = gen.do_sample
         self._temperature[s] = gen.temperature
-        self._top_k[s] = 0 if gen.top_k is None else gen.top_k
-        self._top_p[s] = 1.0 if gen.top_p is None else gen.top_p
-        self._rngs[s] = np.asarray(jax.random.fold_in(self._base_rng, req.rid))
+        self._top_k[s] = top_k_v
+        self._top_p[s] = top_p_v
+        self._rngs[s] = rng
         if self._slot_ever_used[s]:
             self._bump("slots_reused")
         self._slot_ever_used[s] = True
@@ -1012,22 +1125,15 @@ class ServingEngine:
         req.state = RequestState.RUNNING
 
     # ----------------------------------------------------------------- decode
-    def _lane_mark_dirty(self) -> None:
-        """Invalidate the device-resident lane mirror before mutating host
-        lane state (admit/free).  The rng mirror is the one array the host
-        does NOT keep fresh between cycles (decode/verify carry it on
-        device), so it syncs back here — the only lane-state device->host
-        transfer outside token readback."""
-        if self._lane_device is not None:
-            self._rngs = np.array(jax.device_get(self._lane_device[-1]), np.uint32)
-            self._lane_device = None
-
     def _lane_arrays(self) -> list:
         """Device-resident lane vectors in decode/verify argument order
         (pending, active, eos, do_sample, temperature, top_k, top_p, pad,
-        rngs).  Uploaded from the host mirrors only when marked dirty; the
+        rngs).  Uploaded from the host mirrors once; after that the
         pending-token and rng entries are refreshed in place from each
-        window's device-side outputs, so steady-state cycles upload nothing."""
+        window's device-side outputs, installs edit one slot via the
+        ``lane_install`` scatter, and a lane freed since the last dispatch
+        re-uploads just the active mask — steady-state cycles upload
+        nothing and nothing ever blocks on an in-flight window."""
         if self._lane_device is None:
             self._lane_device = [
                 self._put(self._pending_tok), self._put(self._active),
@@ -1037,15 +1143,49 @@ class ServingEngine:
                 self._put(jnp.full((self.num_slots,), self.pad_token_id, jnp.int32)),
                 self._put(self._rngs),
             ]
+            self._mask_stale = False
+        elif self._mask_stale:
+            # a lane was freed while its window was in flight.  The active
+            # mask is host-authoritative (no executable writes it), so the
+            # dead lane is masked out by re-uploading this one vector — no
+            # device sync, and the lane ran exactly one extra masked window.
+            self._stale_handles.append(self._lane_device[1])
+            self._lane_device[1] = self._put(self._active)
+            self._mask_stale = False
         return self._lane_device
 
-    def _free(self, slot: int, req: Request) -> None:
-        self._lane_mark_dirty()
+    def _retire_lane(self, slot: int) -> int:
+        """Tear down one running lane (finish / cancel / preempt), deferring
+        whatever the in-flight window still needs.  If the window was
+        dispatched believing this lane live, its KV pages move to the
+        window's deferral list (they free at drain, after the window's
+        masked writes provably landed) and the device active mask is
+        refreshed at the next dispatch instead of forcing a blocking mirror
+        resync.  Returns pages freed *now* (0 when deferred)."""
+        freed = 0
+        inflight = self._inflight
+        if inflight is not None and inflight.lane_live(slot):
+            self._mask_stale = True
+            if self.paged:
+                inflight.deferred_pages.extend(self.kv.lane_detach(slot))
+        else:
+            # no window holds this lane: pages free immediately, and the
+            # device mirror only needs its active bit dropped (the dead
+            # lane's pending/rng entries are masked out until reinstall)
+            self._mask_stale = True
+            if self.paged:
+                freed = self.kv.lane_release(slot)
         self._active[slot] = False
         self._slot_req[slot] = None
         if self.paged:
-            self.kv.lane_release(slot)
             self._lane_len[slot] = 0
+        return freed
+
+    def _free(self, slot: int, req: Request) -> None:
+        self._retire_lane(slot)
+        self._finish_request(slot, req)
+
+    def _finish_request(self, slot: int, req: Request) -> None:
         req.state = RequestState.DONE
         req.finish_step = self._step_count
         self._bump("requests_completed")
@@ -1054,10 +1194,54 @@ class ServingEngine:
             tokens=len(req.tokens), steps=self._step_count - req.submit_step,
         )
 
+    def _prefree_exhausted(self) -> None:
+        """Retire lanes whose in-flight window provably exhausts their token
+        budget — BEFORE this step's admission, so the slot refills this cycle
+        instead of next.
+
+        Without this, the depth-1 pipeline pays an occupancy lag the sync
+        loop doesn't: a lane finishing inside window N is only discovered at
+        N's drain, which runs after window N+1 dispatched AND after this
+        step's admission — the slot sits dead for a full extra window.  But
+        completion by length cap is host-arithmetic: a lane with no EOS
+        configured lands exactly ``width`` tokens per decode window, so
+        ``len(tokens) + width >= max_new_tokens`` proves death in flight.
+        Such lanes retire here (pages deferred to the window, exactly the
+        cancel-mid-flight path) and their slot admits a new request whose
+        prefill/insert/scatter chain behind the in-flight window on device —
+        the async admission schedule converges to the sync loop's.  The
+        window's tokens still land at drain via the ``prefreed`` mark on the
+        handle.  EOS-configured lanes and speculative lanes (commit counts
+        are decided on device) keep the conservative one-window lag."""
+        hd = self._inflight
+        if hd is None or hd.kind != "decode":
+            return
+        for s in np.nonzero(self._active)[0]:
+            s = int(s)
+            req = self._slot_req[s]
+            if req is None or not hd.lane_live(s) or hd.reqs[s] is not req:
+                continue
+            if self._eos[s] >= 0 or (self.speculate_k and req.speculate):
+                continue
+            if len(req.tokens) + hd.width >= req.config.max_new_tokens:
+                hd.prefreed.add(s)
+                self._retire_lane(s)
+                self._bump("prefreed_lanes")
+
     def _decode_window(self) -> None:
         """One decode phase over the pool: a speculative verify cycle when
-        any lane has an n-gram draft, the plain decode window otherwise."""
+        any lane has an n-gram draft, the plain decode window otherwise.
+
+        Pipelining (``async_depth=1``): the window dispatched here is NOT
+        materialized here — it parks in ``self._inflight`` and the previous
+        window's tokens are drained *after* the new dispatch, so ``_emit``,
+        streaming callbacks, and the next step's admission all run while the
+        device computes.  Speculative cycles drain first instead: drafting
+        and the verify token block need the previous window's tokens."""
+        if self.speculate_k and self._inflight is not None:
+            self._drain_inflight()
         if not self._active.any():
+            self._drain_inflight()
             return
         if self.paged:
             # map pages for the widest pass this cycle could run (the same
@@ -1065,22 +1249,120 @@ class ServingEngine:
             # preempt the youngest lane under pressure, so re-check occupancy
             self._ensure_decode_capacity(max(self.window, self.speculate_k + 1))
             if not self._active.any():
+                self._drain_inflight()
                 return
         n_occupied = int(self._active.sum())
         self.peak_active_lanes = max(self.peak_active_lanes, n_occupied)
         self._occupancy_gauge.set(n_occupied / self.num_slots)
         drafts = self._propose_drafts() if self.speculate_k else None
         if drafts is not None:
-            self._verify_cycle(*drafts, n_occupied=n_occupied)
+            hd = self._verify_cycle(*drafts, n_occupied=n_occupied)
         else:
-            self._decode_cycle(n_occupied)
+            hd = self._decode_cycle(n_occupied)
+        if self.async_depth == 0:
+            self._drain(hd)
+        else:
+            prev, self._inflight = self._inflight, hd
+            if prev is not None:
+                self._drain(prev)
 
-    def _decode_cycle(self, n_occupied: int) -> None:
+    def _drain_inflight(self) -> None:
+        """Flush the pipeline: materialize the in-flight window (if any) and
+        land its tokens.  Called before speculative cycles, when the pool
+        goes idle, and by the page-reclaim ladder to settle deferred pages."""
+        hd, self._inflight = self._inflight, None
+        if hd is not None:
+            self._drain(hd)
+
+    def _note_dispatch(self) -> None:
+        """Charge the gap since the pipeline last went empty as device idle
+        time (the bubble the depth-1 pipeline exists to close)."""
+        if self._t_pipeline_empty is not None:
+            self._device_idle_s += time.perf_counter() - self._t_pipeline_empty
+            self._idle_gauge.set(self._device_idle_s * 1e3)
+            self._t_pipeline_empty = None
+
+    def _drain(self, hd: Readback) -> None:
+        """Land one window's deferred outputs: the ONE blocking readback per
+        window, then all host-side bookkeeping against the window's
+        dispatch-time lane snapshot (a lane freed/cancelled/preempted or
+        re-installed since dispatch fails the ownership check in ``_emit``
+        and its tokens are dropped — exactly what the sync loop would never
+        have produced)."""
+        t0 = time.perf_counter()
+        with self.tracer.span("serve/readback", kind=hd.kind,
+                              occupied=hd.n_occupied):
+            if hd.kind == "verify":
+                toks, counts = fetch(hd.toks, hd.counts)
+            else:
+                toks = fetch(hd.toks)
+                counts = np.full(self.num_slots, hd.width)
+        t1 = time.perf_counter()
+        # overlap accounting: host work since dispatch ran under the device;
+        # the blocking tail is what the pipeline failed to hide.  Under
+        # async_depth=0 the drain follows dispatch immediately, so host ~ 0
+        # and the ratio publishes ~0 — the honest baseline.
+        host = max(t0 - hd.dispatch_t, 0.0)
+        wait = max(t1 - t0, 0.0)
+        self._overlap_host_s += host
+        self._overlap_wait_s += wait
+        denom = self._overlap_host_s + self._overlap_wait_s
+        if denom > 0.0:
+            self._overlap_gauge.set(self._overlap_host_s / denom)
+        self.recorder.record(
+            "serve/readback", step=self._step_count, window=hd.kind,
+            wait_ms=wait * 1e3, overlapped_ms=host * 1e3,
+        )
+        hd.consumed.clear()
+        if hd.qerr is not None and self._kv_quant_gauge is not None:
+            self._kv_quant_gauge.set(float(fetch(hd.qerr)))
+        if hd.kind == "verify":
+            if self.paged:
+                # the write-index mirror advances by what the device actually
+                # committed — but only for lanes still owned by the request
+                # the window was dispatched for (a cancelled lane's mirror
+                # was reset to 0 and must stay there)
+                for s in np.nonzero(hd.active)[0]:
+                    if hd.reqs[s] is not None and self._slot_req[s] is hd.reqs[s]:
+                        self._lane_len[s] += int(counts[s])
+            accepted = int(np.maximum(counts[hd.drafted] - 1, 0).sum())
+            self._bump("spec_accepted", accepted)
+            if self.stats["spec_drafted"]:
+                self._accept_rate_gauge.set(
+                    self.stats["spec_accepted"] / self.stats["spec_drafted"]
+                )
+            self.recorder.record(
+                "serve/verify", step=self._step_count,
+                drafted_lanes=hd.n_drafted, committed=int(counts.sum()),
+                accepted=accepted,
+            )
+        self._emit(toks, counts, mask=hd.active, reqs=hd.reqs, eos=hd.eos,
+                   prefreed=hd.prefreed)
+        if self.paged and hd.deferred_pages:
+            # fetch() above proved the window retired: its masked writes to
+            # detached lanes' pages have landed, so the pages can recycle
+            hd.settle(self.kv.allocator)
+        if self._inflight is None:
+            self._t_pipeline_empty = time.perf_counter()
+
+    def _decode_cycle(self, n_occupied: int) -> Readback:
+        """Dispatch one decode window and return its in-flight handle.  The
+        tokens stay on device: the caller decides when to drain (immediately
+        under ``async_depth=0``, one cycle later under the pipeline).  The
+        window's KV/pending/rng outputs rebind here, at dispatch — so the
+        next dispatch donates the new handles, never a buffer the in-flight
+        window still owns."""
         lanes = self._lane_arrays()
+        self._note_dispatch()
+        qerr = None
         if self.paged and self._direct:
             kv = self.kv
+            audit_donation(kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales)
+            consumed = [kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+                        lanes[0], lanes[-1]]
             tables = self._put(kv.tables)
             index = self._put(self._lane_len)
+            consumed += [tables, index]
             args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
                     kv.v_scales, tables, index, *lanes)
             if not self.cost_table.captured("serve/decode_window"):
@@ -1089,16 +1371,16 @@ class ServingEngine:
                 with self.tracer.span("serve/paged_attn", kernel=self.decode_kernel):
                     (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, toks,
                      pending, rngs, qerr) = self._decode(*args)
-                toks = np.asarray(jax.device_get(toks))
             self._lane_len[self._active] += self.window
-            if self._kv_quant_gauge is not None:
-                self._kv_quant_gauge.set(float(jax.device_get(qerr)))
         elif self.paged:
             kv = self.kv
+            audit_donation(kv.pages_k, kv.pages_v)
+            consumed = [kv.pages_k, kv.pages_v, lanes[0], lanes[-1]]
             # block tables + write indices ride up fresh each cycle (a few KB
             # of int32 — allocation is host-side and can change every cycle)
             tables = self._put(kv.tables)
             index = self._put(self._lane_len)
+            consumed += [tables, index]
             if not self.cost_table.captured("serve/decode_window"):
                 self.cost_table.capture(
                     "serve/decode_window", self._decode,
@@ -1108,9 +1390,10 @@ class ServingEngine:
                 kv.pages_k, kv.pages_v, toks, pending, rngs = self._decode(
                     self.params, kv.pages_k, kv.pages_v, tables, index, *lanes
                 )
-                toks = np.asarray(jax.device_get(toks))
             self._lane_len[self._active] += self.window
         else:
+            audit_donation(self.pool)
+            consumed = [self.pool, lanes[0], lanes[-1]]
             if not self.cost_table.captured("serve/decode_window"):
                 self.cost_table.capture(
                     "serve/decode_window", self._decode, (self.params, self.pool, *lanes)
@@ -1119,13 +1402,18 @@ class ServingEngine:
                 self.pool, toks, pending, rngs = self._decode(
                     self.params, self.pool, *lanes
                 )
-                toks = np.asarray(jax.device_get(toks))
         # the carried pending token / rng live on into the next cycle without
         # touching the host (the host pending mirror is refreshed by _emit)
         lanes[0], lanes[-1] = pending, rngs
         self._bump("decode_steps", self.window)
         self._bump("occupied_lane_steps", n_occupied * self.window)
-        self._emit(toks, np.full(self.num_slots, self.window))
+        consumed += self._stale_handles
+        self._stale_handles = []
+        return Readback(
+            kind="decode", toks=toks, width=self.window, qerr=qerr,
+            active=self._active.copy(), reqs=list(self._slot_req),
+            eos=self._eos.copy(), n_occupied=n_occupied, consumed=consumed,
+        )
 
     def _propose_drafts(self):
         """Host-side n-gram drafts for this cycle: ``(drafts [N, K], drafted
@@ -1152,19 +1440,30 @@ class ServingEngine:
         return drafts, drafted
 
     def _verify_cycle(self, drafts: np.ndarray, drafted: np.ndarray,
-                      n_occupied: int) -> None:
+                      n_occupied: int) -> Readback:
+        """Dispatch one speculative verify window; returns its in-flight
+        handle.  ``n_commit`` stays on device with the tokens — the paged
+        write-index mirror therefore advances at *drain*, which is why
+        speculative cycles drain the previous window before dispatching."""
         k = self.speculate_k
         lanes = self._lane_arrays()
-        # the host pending mirror is always fresh (updated by _emit); only
-        # the [N, K+1] token block uploads per verify cycle
+        self._note_dispatch()
+        # the host pending mirror is always fresh here (a pending verify
+        # handle was drained before drafting); only the [N, K+1] token block
+        # uploads per verify cycle
         tokens = self._put(
             np.concatenate([self._pending_tok[:, None], drafts], axis=1)
         )
         n_drafted = int(drafted.sum())
+        qerr = None
         if self.paged and self._direct:
             kv = self.kv
+            audit_donation(kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales)
+            consumed = [kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+                        lanes[0], lanes[-1]]
             tables = self._put(kv.tables)
             index = self._put(self._lane_len)
+            consumed += [tables, index, tokens]
             args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
                     kv.v_scales, tables, index, tokens, *lanes[1:])
             if not self.cost_table.captured("serve/verify_window"):
@@ -1174,15 +1473,13 @@ class ServingEngine:
                 with self.tracer.span("serve/paged_attn", kernel=self.decode_kernel):
                     (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, out,
                      n_commit, pending, rngs, qerr) = self._verify(*args)
-                out = np.asarray(jax.device_get(out))
-                n_commit = np.asarray(jax.device_get(n_commit))
-            self._lane_len[self._active] += n_commit[self._active]
-            if self._kv_quant_gauge is not None:
-                self._kv_quant_gauge.set(float(jax.device_get(qerr)))
         elif self.paged:
             kv = self.kv
+            audit_donation(kv.pages_k, kv.pages_v)
+            consumed = [kv.pages_k, kv.pages_v, lanes[0], lanes[-1]]
             tables = self._put(kv.tables)
             index = self._put(self._lane_len)
+            consumed += [tables, index, tokens]
             if not self.cost_table.captured("serve/verify_window"):
                 self.cost_table.capture(
                     "serve/verify_window", self._verify,
@@ -1195,10 +1492,9 @@ class ServingEngine:
                     self.params, kv.pages_k, kv.pages_v, tables, index,
                     tokens, *lanes[1:]
                 )
-                out = np.asarray(jax.device_get(out))
-                n_commit = np.asarray(jax.device_get(n_commit))
-            self._lane_len[self._active] += n_commit[self._active]
         else:
+            audit_donation(self.pool)
+            consumed = [self.pool, lanes[0], lanes[-1], tokens]
             if not self.cost_table.captured("serve/verify_window"):
                 self.cost_table.capture(
                     "serve/verify_window", self._verify,
@@ -1209,42 +1505,62 @@ class ServingEngine:
                 self.pool, out, n_commit, pending, rngs = self._verify(
                     self.params, self.pool, tokens, *lanes[1:]
                 )
-                out = np.asarray(jax.device_get(out))
-                n_commit = np.asarray(jax.device_get(n_commit))
         lanes[0], lanes[-1] = pending, rngs
         self._bump("decode_steps", k + 1)
         self._bump("occupied_lane_steps", n_occupied * (k + 1))
-        accepted = int(np.maximum(n_commit[drafted] - 1, 0).sum())
         self._bump("spec_drafted", n_drafted * k)
-        self._bump("spec_accepted", accepted)
-        if self.stats["spec_drafted"]:
-            self._accept_rate_gauge.set(
-                self.stats["spec_accepted"] / self.stats["spec_drafted"]
-            )
-        self.recorder.record(
-            "serve/verify", step=self._step_count, drafted_lanes=n_drafted,
-            committed=int(n_commit.sum()), accepted=accepted,
+        consumed += self._stale_handles
+        self._stale_handles = []
+        return Readback(
+            kind="verify", toks=out, width=k + 1, counts=n_commit, qerr=qerr,
+            active=self._active.copy(), reqs=list(self._slot_req),
+            eos=self._eos.copy(), n_occupied=n_occupied,
+            drafted=drafted.copy(), n_drafted=n_drafted, consumed=consumed,
         )
-        self._emit(out, n_commit)
 
-    def _emit(self, toks: np.ndarray, counts: np.ndarray) -> None:
+    def _emit(self, toks: np.ndarray, counts: np.ndarray,
+              mask: Optional[np.ndarray] = None,
+              reqs: Optional[List[Optional[Request]]] = None,
+              eos: Optional[np.ndarray] = None,
+              prefreed: Optional[set] = None) -> None:
         """Land device-produced tokens on their requests. ``toks[s, :counts[s]]``
         is lane ``s``'s output this cycle (a full decode window, or a verify
         cycle's committed prefix).  Per-lane take counts — EOS cut plus the
         per-request length cap — are computed in one numpy pass so host time
         stays flat in window size / speculate_k; only genuine per-request
-        bookkeeping (streaming callbacks, histograms, frees) runs in Python."""
+        bookkeeping (streaming callbacks, histograms, frees) runs in Python.
+
+        ``mask``/``reqs``/``eos`` are the window's dispatch-time snapshots
+        (:class:`Readback`): under the pipeline the live lane state may have
+        moved on — a lane freed/cancelled/preempted since dispatch no longer
+        owns its slot, so the ownership check drops its tokens."""
+        if mask is None:
+            mask = self._active
+        if reqs is None:
+            reqs = self._slot_req
+        if eos is None:
+            eos = self._eos
         width = toks.shape[1]
         pos = np.arange(width)[None, :]
-        valid = (pos < np.asarray(counts).reshape(-1, 1)) & self._active[:, None]
-        is_eos = valid & (toks == self._eos[:, None]) & (self._eos >= 0)[:, None]
+        valid = (pos < np.asarray(counts).reshape(-1, 1)) & mask[:, None]
+        is_eos = valid & (toks == eos[:, None]) & (eos >= 0)[:, None]
         has_eos = is_eos.any(axis=1)
         first_eos = np.where(has_eos, is_eos.argmax(axis=1), width)
         n_take = np.minimum(valid.sum(axis=1), first_eos + 1)
         now = time.perf_counter()
         for s in np.nonzero(n_take > 0)[0]:
-            req = self._slot_req[s]
+            req = reqs[s]
             if req is None:
+                continue
+            owner = self._slot_req[s] is req
+            # a slot with a new owner normally drops this window's tokens
+            # (the lane was cancelled/preempted) — unless the lane was
+            # PRE-FREED: retired early because this very window provably
+            # finishes it, in which case its tokens are the request's tail
+            if not owner and not (
+                prefreed and int(s) in prefreed
+                and req.state is RequestState.RUNNING
+            ):
                 continue
             # the device can land more than the request's remaining budget in
             # one verify cycle; the cap truncation below keeps outputs exactly
@@ -1263,16 +1579,22 @@ class ServingEngine:
             req.last_token_time = now
             hit_eos = bool(has_eos[s]) and n == int(n_take[s])
             if hit_eos or len(req.tokens) >= req.config.max_new_tokens:
-                self._free(s, req)
-            else:
+                if owner:
+                    self._free(s, req)
+                else:
+                    # pre-freed: the lane was already retired and the slot
+                    # reassigned — only the request itself completes here
+                    self._finish_request(int(s), req)
+            elif owner:
                 self._pending_tok[s] = int(toks[s, n - 1])
 
     # ------------------------------------------------------------------ drive
     def step(self) -> None:
         """One engine iteration: budgeted chunked-prefill admission, then one
         masked decode window over the pool."""
-        queue_depth = len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
+        queue_depth = self.scheduler.queue_depth
         self._queue_gauge.set(queue_depth)
+        self._prefree_exhausted()
         self._admit()
         if self.prefix_cache is not None:
             covered = self.stats["prefix_hit_tokens"] + self.stats["prefix_miss_tokens"]
@@ -1291,11 +1613,15 @@ class ServingEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.scheduler.has_queued or bool(self._active.any())
+        # an in-flight window is work: its tokens haven't landed yet, so the
+        # driver keeps stepping until the pipeline flushes (the trailing step
+        # finds no active lane and drains)
+        return (self.scheduler.has_queued or bool(self._active.any())
+                or self._inflight is not None)
 
     def _log_health(self, dt: float, d_tokens: int) -> None:
         """One-line serve-health summary (the ``metrics_interval`` heartbeat)."""
-        queued = len(self.scheduler.queue) + (self.scheduler.prefilling is not None)
+        queued = self.scheduler.queue_depth
         occupancy = float(self._active.mean()) if self.num_slots else 0.0
         p99_ms = self._token_hist.percentile(99) * 1e3
         logger.info(
@@ -1411,8 +1737,11 @@ class ServingEngine:
         until the first drafted cycle).  Paged mode swaps insert and the
         per-bucket copies for a single ``copy_page`` (0 until the first
         copy-on-write); cache hits alias pages, so the hit path adds no
-        executable at all."""
-        out = {"decode_window": jit_cache_sizes(self._decode)}
+        executable at all.  ``lane_install`` is the one-slot lane-vector
+        scatter admissions enqueue once the device mirror exists — 0 when
+        every install landed before the first window."""
+        out = {"decode_window": jit_cache_sizes(self._decode),
+               "lane_install": jit_cache_sizes(self._lane_install)}
         if self.paged:
             out["copy_page"] = jit_cache_sizes(self._copy_page)
         else:

@@ -268,11 +268,23 @@ class PagedKVPool:
     def lane_release(self, slot: int) -> int:
         """Unmap the whole lane (finish / cancel / preempt): deref every
         mapped page and reset the row to the null sink.  Returns pages freed."""
+        freed = self.allocator.deref(self.lane_detach(slot))
+        return freed
+
+    def lane_detach(self, slot: int) -> List[int]:
+        """Unmap the lane NOW but keep its page references alive: the row
+        resets to the null sink (the next table upload routes any further
+        write for this lane to the garbage page) and the physical ids come
+        back to the caller, who derefs them later.  This is the async serve
+        loop's deferred release: a window dispatched while the lane was live
+        still holds the OLD table on device and may write these pages, so
+        they must not return to the allocator until that window retires
+        (:meth:`~accelerate_tpu.serving.readback.Readback.settle`)."""
         n = int(self.lane_npages[slot])
-        freed = self.allocator.deref([int(p) for p in self.tables[slot, :n]])
+        held = [int(p) for p in self.tables[slot, :n]]
         self.tables[slot, :] = NULL_PAGE
         self.lane_npages[slot] = 0
-        return freed
+        return held
 
     def chunk_ids(self, slot: int, start_page: int, n: int) -> List[int]:
         """Physical ids backing ``n`` logical page slots from ``start_page``

@@ -108,6 +108,27 @@ def _serve_jit(fn, *, donate_argnums=(), in_shardings=None, out_shardings=None):
     )
 
 
+def audit_donation(*trees) -> None:
+    """Assert no leaf of ``trees`` has already been donated (its buffer
+    deleted by a prior dispatch).  The engine calls this on the KV state it
+    is about to donate into a window: under the pipelined loop
+    (``async_depth=1``) every window's outputs rebind ``self.pool`` / the
+    page arrays *at dispatch*, so the next dispatch always donates the fresh
+    handles — this audit turns any future violation of that invariant (a
+    double donation, which XLA reports as a use-after-free much later and
+    far from the cause) into an immediate, attributable error.  Host-only
+    and O(leaves): no device sync."""
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            deleted = getattr(leaf, "is_deleted", None)
+            if deleted is not None and deleted():
+                raise RuntimeError(
+                    "KV buffer was already donated to an earlier dispatch "
+                    "(use-after-donation): a window's outputs must be rebound "
+                    "before the next window dispatches"
+                )
+
+
 def _decode_scan(model: Transformer, window: int, params, cache, tokens, active,
                  eos, do_sample, temperature, top_k, top_p, pad, rngs):
     """The masked decode scan shared by the slab and paged decode windows —
@@ -157,6 +178,14 @@ def make_decode_window(model: Transformer, window: int,
     ``new_pending`` is the scan's final carry token per lane — the token the
     next window will feed — returned device-side so the engine's lane-state
     mirrors never round-trip through the host between windows.
+
+    Return packing is readback-friendly by design: ``out_tokens`` is its own
+    output leaf (never folded into the carried cache/lane state), so the
+    pipelined engine can park just that handle in a :class:`.readback.Readback`
+    and dispatch the next window — which donates and rebinds the cache —
+    without the deferred token fetch ever touching a donated buffer.  All
+    outputs of one call materialize together, so fetching ``out_tokens``
+    also proves the window's KV writes landed.
 
     Semantics per scan step (matching ``generate``'s loop body lane-by-lane):
     the pending token is fed at each lane's own position, its KV is written
@@ -355,6 +384,46 @@ def make_insert(shardings: Optional[ServeShardings] = None):
         donate_argnums=(0,),
         in_shardings=None if s is None else (s.cache(), s.kv, s.kv, *s.rep(2)),
         out_shardings=None if s is None else s.cache(),
+    )
+
+
+def make_lane_install(shardings: Optional[ServeShardings] = None):
+    """Jitted one-slot edit of the device-resident lane vectors.
+
+    ``(pending [N], active [N], eos [N], do_sample [N], temperature [N],
+    top_k [N], top_p [N], rngs [N,2], slot, tok, eos_v, do_sample_v,
+    temperature_v, top_k_v, top_p_v, rng [2]) -> (the eight vectors,
+    updated at ``slot``)``
+
+    Admission under the pipelined loop must not read lane state back from
+    the device: the pending/rng vectors are carried on device between
+    windows, so a host round-trip blocks on the in-flight window and turns
+    every install into a depth-1 pipeline sync.  This scatter instead
+    *enqueues* the edit — it consumes the in-flight window's output handles
+    and therefore runs right after that window retires, off the host's
+    critical path.  Inputs are not donated: the vectors are a few hundred
+    bytes and the in-flight window may still hold them as operands.
+    """
+
+    def lane_install(pending, active, eos, do_sample, temperature, top_k,
+                     top_p, rngs, slot, tok, eos_v, do_sample_v,
+                     temperature_v, top_k_v, top_p_v, rng):
+        return (
+            pending.at[slot].set(tok),
+            active.at[slot].set(True),
+            eos.at[slot].set(eos_v),
+            do_sample.at[slot].set(do_sample_v),
+            temperature.at[slot].set(temperature_v),
+            top_k.at[slot].set(top_k_v),
+            top_p.at[slot].set(top_p_v),
+            rngs.at[slot].set(rng),
+        )
+
+    s = shardings
+    return _serve_jit(
+        lane_install,
+        in_shardings=None if s is None else s.rep(16),
+        out_shardings=None if s is None else s.rep(8),
     )
 
 

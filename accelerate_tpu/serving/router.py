@@ -89,13 +89,11 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------- placement
     def _load(self, engine: ServingEngine) -> int:
-        """Host-side load proxy: queued + mid-prefill + active lanes."""
-        sched = engine.scheduler
-        return (
-            len(sched.queue)
-            + (sched.prefilling is not None)
-            + int(engine._active.sum())
-        )
+        """Host-side load proxy: queued + mid-prefill + active lanes.  Under
+        the pipelined engine loop (``async_depth=1``) the active count lags
+        a finishing lane by one drain — at most one step of load skew per
+        replica, in the conservative (over-counting) direction."""
+        return engine.scheduler.queue_depth + int(engine._active.sum())
 
     def _affinity(self, engine: ServingEngine, prompt: np.ndarray) -> int:
         """Tokens of ``prompt`` this replica's radix tree already holds —
@@ -179,7 +177,12 @@ class ReplicaRouter:
     def step(self) -> None:
         """One iteration of every replica that has work (round-robin drive —
         in production each replica runs its own host loop/process; this
-        single-threaded drive is what tests and benches use)."""
+        single-threaded drive is what tests and benches use).  Each replica
+        runs its own depth-1 pipeline (``async_depth=1``): with window k in
+        flight on replica A, the drive moves on to dispatch replica B's
+        window while A's device computes, so even the single-threaded drive
+        overlaps replicas; ``has_work`` holds until every replica's pipeline
+        has drained (an in-flight window counts as work)."""
         for e in self.engines:
             if e.has_work:
                 e.step()
@@ -238,8 +241,7 @@ class ReplicaRouter:
             ),
             "per_replica": [
                 {
-                    "queue_depth": len(e.scheduler.queue)
-                    + (e.scheduler.prefilling is not None),
+                    "queue_depth": e.scheduler.queue_depth,
                     "active_lanes": int(e._active.sum()),
                     "tp_degree": e.tp_degree,
                     "has_work": e.has_work,

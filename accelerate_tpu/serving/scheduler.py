@@ -228,6 +228,17 @@ class Scheduler:
     def has_queued(self) -> bool:
         return bool(self.queue) or self.prefilling is not None
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting or mid-prefill — the ``serve/queue_depth`` gauge
+        and the router's load signal.  Admission runs against the engine's
+        HOST lane state, which under the pipelined loop (``async_depth=1``)
+        is authoritative even while a window is in flight: a lane retired at
+        drain frees its slot immediately, one step after the sync loop would
+        have (the documented EOS lag), so queue depth can read one step
+        higher than ``async_depth=0`` under churn — never lower."""
+        return len(self.queue) + (self.prefilling is not None)
+
     def begin_step(self) -> int:
         """Fresh prefill-token budget for this engine step."""
         return self.budget

@@ -22,6 +22,15 @@ back to the plain decode window whenever no lane drafts.
 
 Device-side verification lives in :func:`~.pool.make_verify_window`; the
 engine (:mod:`.engine`) wires the two together per cycle.
+
+Drafting is the one serve-loop stage that is *inherently sequential* with
+the previous window: a lane's draft extends its own freshest context, so the
+pipelined loop (``ServingEngine(async_depth=1)``) drains the in-flight
+window before calling :func:`propose_ngram_draft` — speculative cycles
+overlap scheduling/admission with device compute, but not drafting or
+``_emit``.  Keep the per-lane cost here strictly O(context) numpy with no
+device interaction: this function runs on the host's critical path between
+a drain and the next dispatch.
 """
 
 from __future__ import annotations

@@ -295,9 +295,10 @@ def _paged_ab_bench(args, model, cfg, params, preset):
     long prompt (0.75-1x the longest admissible), the rest are short turns.
     The legacy arm reserves a full ``max_len`` slab per lane, so its KV
     budget — ``(slots + 1)`` slabs counting the prefill scratch — admits only
-    a couple of lanes.  The paged arm gets a page pool of EXACTLY the same
-    byte size (asserted via ``kv_pool_bytes``) but allocates per page, so
-    short requests stop paying for the tail's worst case.  The headline
+    a couple of lanes.  The paged arm gets a page pool of the same byte
+    budget rounded DOWN to whole pages, scale arrays included (asserted
+    ``<=`` via ``kv_pool_bytes``), but allocates per page, so short
+    requests stop paying for the tail's worst case.  The headline
     metric is the ratio of peak concurrent lanes; outputs must be
     token-identical between the arms or the bench exits nonzero.
 
@@ -338,10 +339,21 @@ def _paged_ab_bench(args, model, cfg, params, preset):
     legacy_slots = 2
     pages_per_lane = max_len // page
     # equal KV HBM: legacy pays (slots + 1) full-width slabs (pool + prefill
-    # scratch); the paged pool gets exactly that many bytes worth of pages
-    # (one of which is the reserved null page — the paged arm absorbs that
-    # handicap rather than rounding the budget up)
-    num_pages = (legacy_slots + 1) * pages_per_lane
+    # scratch); the paged pool gets AT MOST that many bytes worth of pages.
+    # A paged page costs more than its slab-equivalent span: since the
+    # quantized-KV PR every page carries per-(page, kv-head) f32 scale
+    # arrays even at native dtype, so the page count comes from dividing the
+    # legacy byte budget by the full per-page cost (scales included) and
+    # rounding DOWN — the paged arm absorbs both the rounding and the
+    # reserved null page rather than rounding the budget up.
+    from accelerate_tpu.serving.paging import PagedKVPool
+
+    # 2-page probe (1-page lane + null) just to read the per-page byte cost
+    probe = PagedKVPool(cfg, 1, page, page, 2, registry=MetricsRegistry())
+    page_data_bytes = (int(probe.pages_k.nbytes) + int(probe.pages_v.nbytes)) // 2
+    legacy_bytes = (legacy_slots + 1) * pages_per_lane * page_data_bytes
+    num_pages = max(pages_per_lane + 1, legacy_bytes // probe.page_kv_bytes)
+    del probe
 
     def run_arm(paged):
         registry = MetricsRegistry()
@@ -371,11 +383,11 @@ def _paged_ab_bench(args, model, cfg, params, preset):
             "paged KV allocator changed greedy outputs: paged-arm tokens "
             "differ from the legacy slab arm on the same workload"
         )
-    if eng_paged.kv_pool_bytes() != eng_slab.kv_pool_bytes():
+    if eng_paged.kv_pool_bytes() > eng_slab.kv_pool_bytes():
         raise SystemExit(
             f"KV budgets diverged: paged arm holds {eng_paged.kv_pool_bytes()} "
             f"bytes vs legacy {eng_slab.kv_pool_bytes()} — the A/B is only "
-            "meaningful at equal HBM"
+            "meaningful when the paged arm fits the legacy byte budget"
         )
     peak_ratio = eng_paged.peak_active_lanes / max(1, eng_slab.peak_active_lanes)
 
@@ -412,6 +424,223 @@ def _paged_ab_bench(args, model, cfg, params, preset):
         "value": round(peak_ratio, 3),
         "unit": "x",
         "vs_baseline": round(peak_ratio, 3),
+        "detail": detail,
+    }
+
+
+def _async_ab_bench(args, model, cfg, params, preset):
+    """Depth-1 pipelined serve loop vs the synchronous loop.
+
+    Two claims, both hard-enforced:
+
+    * **Token identity** — ``async_depth=1`` must produce bitwise-identical
+      outputs to ``async_depth=0`` on the same request stream, across every
+      sampling/pool mode the pipeline threads through: greedy and sampled on
+      the slab pool, speculative decoding, the paged pool, and int8
+      quantized KV pages.  Any divergence exits nonzero.
+    * **Overlap pays** — on a timed greedy arm whose decode window carries
+      real compute (a fixed ~10M-param float32 geometry; the identity
+      presets price a CPU window near zero, where an A/B only measures
+      scheduler noise), with every token streamed through an ``on_token``
+      consumer with ~100us of client delivery latency (the network flush a
+      real streaming server pays per token — exactly the host-side time the
+      pipeline exists to hide), the async loop must be >= 10% faster
+      tokens/s, publish ``serve/host_overlap_ratio > 0``, and compile
+      EXACTLY the same executable set as the sync loop (the pipeline
+      re-orders host work; it must never add device programs).  Arm timings
+      are best-of-two, interleaved, to keep background-load drift
+      symmetric.
+
+    The headline metric is the async/sync tokens/s ratio; ``detail.overlap``
+    records the published overlap ratio and cumulative device idle ms of
+    both arms.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    STREAM_DELAY_S = 100e-6  # per-token client delivery latency, timed arms
+
+    params = jax.device_put(params)
+    window = args.decode_window
+    max_len = cfg.max_seq_len
+    mp = max(8, min(args.seq, max_len) // 2)
+    # bucket pair with bucket[0] | bucket[1] so the paged arms' default
+    # page_size (the bucket gcd) divides every bucket and the page-aligned
+    # slot length below
+    page = max(8, mp // 4)
+    buckets = (page, 2 * page)
+
+    r = np.random.default_rng(args.serve_seed)
+    n = args.requests
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, n)), 4, mp
+    ).astype(int)
+    prompts = [
+        r.integers(1, cfg.vocab_size, (int(p),)).astype(np.int32)
+        for p in prompt_lens
+    ]
+    out_cap = min(max_len - window - mp, 2 * mp)
+    out_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, out_cap // 4)), 0.8, n)),
+        window, out_cap,
+    ).astype(int)
+    useful_tokens = int(out_lens.sum())
+    need = int(max(p + o for p, o in zip(prompt_lens, out_lens))) + window
+    slot_len = min((max_len // page) * page, -(-need // page) * page)
+
+    def run(async_depth, configs, timed=False, bundle=None, **kw):
+        b_model, b_params, b_vocab, b_slot_len, b_buckets, b_mp, b_prompts = (
+            bundle if bundle is not None
+            else (model, params, cfg.vocab_size, slot_len, buckets, mp, prompts)
+        )
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            b_model, b_params, num_slots=args.batch, max_len=b_slot_len,
+            prefill_buckets=b_buckets, max_prompt_len=b_mp, decode_window=window,
+            registry=registry, prefix_cache_mb=0, async_depth=async_depth,
+            **kw,
+        )
+        # warm must cover every executable the timed serve dispatches,
+        # including the ``lane_install`` scatter — that one only compiles on
+        # an admission AFTER the first decode window (the device lane mirror
+        # must already exist), so warm with more requests than slots
+        warm = [r.integers(1, b_vocab, (b_buckets[0],)).astype(np.int32)
+                for _ in range(args.batch + 2)]
+        warm[:len(b_buckets)] = [
+            r.integers(1, b_vocab, (b,)).astype(np.int32) for b in b_buckets
+        ]
+        eng.serve(warm, GenerationConfig(max_new_tokens=window))
+        for k in eng.stats:
+            eng.stats[k] = 0
+        registry.reset()
+        # streaming consumers: each token is delivered to a client that takes
+        # ~100us to flush (the SSE/network round-trip every streaming server
+        # pays).  The wait releases the GIL, so the in-flight window computes
+        # right through it — this is exactly the host-side latency the
+        # pipeline hides.  The sync loop pays it serially: its drain runs
+        # with nothing in flight.  Kept as a wait, not spin: on a shared-core
+        # CPU host, busy host work would steal cycles from the "device"
+        stamps = {}
+
+        def on_token(req, tok):
+            stamps.setdefault(req.rid, []).append(tok)
+            time.sleep(STREAM_DELAY_S)
+
+        t0 = time.perf_counter()
+        reqs = eng.serve(b_prompts, configs, on_token=on_token if timed else None)
+        dt = time.perf_counter() - t0
+        return eng, [q.tokens for q in reqs], dt, registry
+
+    greedy = [GenerationConfig(max_new_tokens=int(o)) for o in out_lens]
+    sampled = [
+        GenerationConfig(max_new_tokens=int(o), do_sample=True,
+                         temperature=0.8, top_k=40, top_p=0.9)
+        for o in out_lens
+    ]
+    arms = {
+        "greedy_slab": (greedy, {}),
+        "sampled_slab": (sampled, {}),
+        "speculative": (greedy, {"speculate_k": 4}),
+        "paged": (greedy, {"paged": True}),
+        "paged_int8_kv": (greedy, {"paged": True, "kv_dtype": "int8"}),
+    }
+    identity = {}
+    for name, (configs, kw) in arms.items():
+        _, toks_async, _, _ = run(1, configs, **kw)
+        _, toks_sync, _, _ = run(0, configs, **kw)
+        if toks_async != toks_sync:
+            raise SystemExit(
+                f"async pipelined loop changed outputs on the {name} arm: "
+                "async_depth=1 tokens differ from async_depth=0 on the same "
+                "request stream"
+            )
+        identity[name] = True
+
+    # Timed arm: greedy + streaming callbacks.  Overlap can only pay when a
+    # decode window *costs* something next to the host/stream side it hides —
+    # on the identity presets a CPU window is ~1ms against ~15ms of streaming
+    # waits, so an A/B there measures scheduler noise, not the pipeline.  The
+    # timed arm therefore runs a fixed geometry that prices a window at
+    # ~20ms on a CPU host (comparable to emit + admission + streaming), with
+    # short prompts so prefill stays a sliver of the wall.  Interleaved
+    # best-of-two per arm — single-run wall times on a small shared host
+    # swing with background load, and alternating keeps any drift symmetric.
+    from accelerate_tpu.models.transformer import Transformer, TransformerConfig
+
+    cfg_t = TransformerConfig(
+        vocab_size=2048, hidden_size=192, intermediate_size=768,
+        num_layers=3, num_heads=6, num_kv_heads=6, max_seq_len=256,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    model_t = Transformer(cfg_t)
+    params_t = jax.device_put(
+        model_t.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    prompts_t = [
+        r.integers(1, cfg_t.vocab_size, (16,)).astype(np.int32) for _ in range(n)
+    ]
+    out_t = [int(o) for o in r.integers(6 * window, 12 * window + 1, n)]
+    timed_tokens = int(sum(out_t))
+    greedy_t = [GenerationConfig(max_new_tokens=o) for o in out_t]
+    bundle_t = (model_t, params_t, cfg_t.vocab_size,
+                16 + 12 * window + 2 * window, (16, 32), 32, prompts_t)
+    eng_s, _, dt_s1, reg_s = run(0, greedy_t, timed=True, bundle=bundle_t)
+    eng_a, _, dt_a1, reg_a = run(1, greedy_t, timed=True, bundle=bundle_t)
+    _, _, dt_s2, _ = run(0, greedy_t, timed=True, bundle=bundle_t)
+    _, _, dt_a2, _ = run(1, greedy_t, timed=True, bundle=bundle_t)
+    dt_sync = min(dt_s1, dt_s2)
+    dt_async = min(dt_a1, dt_a2)
+    tps_sync = timed_tokens / dt_sync
+    tps_async = timed_tokens / dt_async
+    speedup = tps_async / tps_sync
+    overlap = float(reg_a.get("serve/host_overlap_ratio").value)
+    overlap_sync = float(reg_s.get("serve/host_overlap_ratio").value)
+    if eng_a.compiled_executable_counts() != eng_s.compiled_executable_counts():
+        raise SystemExit(
+            f"async loop changed the compiled-executable budget: "
+            f"{eng_a.compiled_executable_counts()} vs "
+            f"{eng_s.compiled_executable_counts()}"
+        )
+    if overlap <= 0.0:
+        raise SystemExit(
+            "async arm published serve/host_overlap_ratio == 0: the pipeline "
+            "never overlapped host work with device compute"
+        )
+    if speedup < 1.10:
+        raise SystemExit(
+            f"async pipelined loop too slow: {tps_async:.1f} vs "
+            f"{tps_sync:.1f} tokens/s ({speedup:.3f}x, need >= 1.10x)"
+        )
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "num_slots": args.batch,
+        "decode_window": window,
+        "useful_tokens": useful_tokens,
+        "timed_tokens": timed_tokens,
+        "timed_config": {
+            "hidden_size": cfg_t.hidden_size, "num_layers": cfg_t.num_layers,
+            "vocab_size": cfg_t.vocab_size, "dtype": "float32",
+        },
+        "stream_delay_us": round(STREAM_DELAY_S * 1e6, 1),
+        "outputs_token_identical": identity,
+        "tokens_per_s": {"async": round(tps_async, 2), "sync": round(tps_sync, 2)},
+        "wall_s": {"async": round(dt_async, 3), "sync": round(dt_sync, 3)},
+        "overlap": {
+            "host_overlap_ratio": round(overlap, 4),
+            "host_overlap_ratio_sync": round(overlap_sync, 4),
+            "device_idle_ms": round(float(reg_a.get("serve/device_idle_ms").value), 2),
+            "device_idle_ms_sync": round(float(reg_s.get("serve/device_idle_ms").value), 2),
+        },
+        "compiled_executables": eng_a.compiled_executable_counts(),
+    }
+    return {
+        "metric": "serving_async_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
         "detail": detail,
     }
 
@@ -913,15 +1142,18 @@ def _serve_bench(args, model, cfg, params, preset):
     if sum([bool(getattr(args, "paged_ab", False)),
             bool(getattr(args, "kernel_ab", False)),
             bool(getattr(args, "tp_ab", False)),
+            bool(getattr(args, "async_ab", False)),
             bool(args.shared_prefix)]) > 1:
-        raise SystemExit("--paged-ab, --kernel-ab, --tp-ab and --shared-prefix "
-                         "are separate serve workloads; pick one")
+        raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab and "
+                         "--shared-prefix are separate serve workloads; pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "kernel_ab", False):
         return _kernel_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "tp_ab", False):
         return _tp_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "async_ab", False):
+        return _async_ab_bench(args, model, cfg, params, preset)
 
     from accelerate_tpu.models.generation import GenerationConfig, generate
     from accelerate_tpu.serving import ServingEngine
@@ -1121,6 +1353,13 @@ def main():
                              "executable-budget hard checks) plus router "
                              "affinity vs round-robin on a shared-prefix "
                              "workload; writes MULTICHIP_r06.json on success")
+    parser.add_argument("--async-ab", dest="async_ab", action="store_true",
+                        help="--task serve: A/B the depth-1 pipelined serve "
+                             "loop (async_depth=1) against the synchronous "
+                             "loop — token-identity across greedy/sampled/"
+                             "speculative/paged/int8-KV arms, >= 10% tokens/s "
+                             "on the streaming greedy arm, overlap gauge > 0, "
+                             "and an unchanged compiled-executable budget")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",
                         help="--kernel-ab: quantized KV page format for the "

@@ -36,6 +36,7 @@ SLOW_MODULES = {
     "test_generation",
     "test_pipeline",
     "test_serving",
+    "test_serving_async",
     "test_serving_mesh",
     "test_flash_attention",
     "test_ring_attention",

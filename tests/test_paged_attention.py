@@ -302,7 +302,8 @@ class TestEngineKernelIdentity:
         gen = GenerationConfig(max_new_tokens=4, do_sample=False, eos_token_id=None)
         eng, _ = _serve(model, params, prompts, gen, decode_kernel="pallas")
         counts = eng.compiled_executable_counts()
-        assert set(counts) == {"decode_window", "copy_page", "prefill_4", "prefill_8"}
+        assert set(counts) == {"decode_window", "copy_page", "lane_install",
+                               "prefill_4", "prefill_8"}
         assert counts["decode_window"] == 1
         assert not eng._decode.over_budget()
 
@@ -347,7 +348,8 @@ class TestEngineQuantizedKV:
         gen = GenerationConfig(max_new_tokens=4, do_sample=False, eos_token_id=None)
         eng, _ = _serve(model, params, prompts, gen, kv_dtype="int8")
         counts = eng.compiled_executable_counts()
-        assert set(counts) == {"decode_window", "copy_page", "prefill_4", "prefill_8"}
+        assert set(counts) == {"decode_window", "copy_page", "lane_install",
+                               "prefill_4", "prefill_8"}
         assert all(c <= 1 for c in counts.values())
 
     def test_preemption_replay_is_deterministic_under_int8(self):

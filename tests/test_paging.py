@@ -172,7 +172,8 @@ class TestPagedTokenIdentity:
         gen = GenerationConfig(max_new_tokens=4, do_sample=False, eos_token_id=None)
         eng, _ = self._serve(model, params, prompts, gen, paged=True)
         counts = eng.compiled_executable_counts()
-        assert set(counts) == {"decode_window", "copy_page", "prefill_4", "prefill_8"}
+        assert set(counts) == {"decode_window", "copy_page", "lane_install",
+                               "prefill_4", "prefill_8"}
         assert counts["decode_window"] == 1
         assert counts["prefill_4"] == 1 and counts["prefill_8"] == 1
         assert counts["copy_page"] <= 1  # compiles only on the first COW
@@ -295,8 +296,12 @@ class TestPagedPressure:
                   for n in (12, 16))
         gen = GenerationConfig(max_new_tokens=16, do_sample=False, eos_token_id=None)
         expect2 = _expected(model, params, p2, gen)
+        # async_depth=0: this test pins the *immediate* page-return contract
+        # of the synchronous loop.  Under the depth-1 pipeline the pages are
+        # deferred until the in-flight window retires — that path is covered
+        # by test_serving_async.py::test_cancel_running_mid_flight.
         eng = _engine(model, params, paged=True, prefix_cache_mb=None,
-                      registry=MetricsRegistry())
+                      registry=MetricsRegistry(), async_depth=0)
         r1 = eng.submit(p1, config=gen)
         r2 = eng.submit(p2, config=gen)
         while r1.state.value != "running":

@@ -171,8 +171,9 @@ class TestCompiledShapes:
         counts = eng.compiled_executable_counts()
         # copy executables exist (prefix cache on by default) but stay
         # uncompiled: random prompts share no prefixes
-        assert counts == {"decode_window": 1, "insert": 1, "prefill_4": 1,
-                          "prefill_8": 1, "copy_4": 0, "copy_8": 0}
+        assert counts == {"decode_window": 1, "insert": 1, "lane_install": 1,
+                          "prefill_4": 1, "prefill_8": 1, "copy_4": 0,
+                          "copy_8": 0}
 
     def test_mixed_sampling_configs_share_decode_executable(self):
         """Per-request knobs (greedy vs sampled, different temps/top-k/eos)
@@ -467,8 +468,8 @@ class TestPrefixCacheEngine:
         for req, prompt in zip(reqs, prompts):
             assert req.tokens == _expected(model, params, prompt, gen)
         assert eng.compiled_executable_counts() == {
-            "decode_window": 1, "insert": 1, "prefill_4": 1, "prefill_8": 1,
-            "copy_4": 1, "copy_8": 1,
+            "decode_window": 1, "insert": 1, "lane_install": 1,
+            "prefill_4": 1, "prefill_8": 1, "copy_4": 1, "copy_8": 1,
         }
         assert not any(wd.over_budget() for wd in eng._copy.values())
 
@@ -491,7 +492,8 @@ class TestPrefixCacheEngine:
         for req, prompt in zip(reqs, prompts):
             assert req.tokens == _expected(model, params, prompt, gen)
         assert eng.compiled_executable_counts() == {
-            "decode_window": 1, "copy_page": 1, "prefill_4": 1, "prefill_8": 1,
+            "decode_window": 1, "copy_page": 1, "lane_install": 1,
+            "prefill_4": 1, "prefill_8": 1,
         }
         assert not eng._decode.over_budget()
         assert not eng._copy_page.over_budget()
@@ -665,7 +667,8 @@ class TestSpeculative:
         assert eng.stats["spec_drafted"] > 0
         assert eng.compiled_executable_counts() == {
             "decode_window": 1, "insert": 1, "verify_window": 1,
-            "prefill_4": 1, "prefill_8": 1, "copy_4": 0, "copy_8": 0,
+            "lane_install": 1, "prefill_4": 1, "prefill_8": 1,
+            "copy_4": 0, "copy_8": 0,
         }
         assert not eng._verify.over_budget()
 

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Lint: no blocking device->host readback in the serving hot path.
+
+The pipelined serve loop (``ServingEngine(async_depth=1)``) works because
+dispatching window N+1 never waits on window N — every device->host
+materialization is funneled through ``serving/readback.py``'s ``fetch``,
+drained at the one point the engine has decided to block.  A stray
+``jax.device_get`` (or ``.block_until_ready()``) anywhere else in
+``accelerate_tpu/serving/`` silently re-serializes the pipeline: the loop
+still produces identical tokens, just without the overlap, which is exactly
+the kind of regression that survives every correctness test.
+
+Flags, in any ``accelerate_tpu/serving/*.py``:
+
+* calls to ``device_get`` (``jax.device_get``, bare ``device_get``, or any
+  dotted path ending in it);
+* calls to / references of ``block_until_ready``.
+
+Exempt:
+
+* ``serving/readback.py`` — the one sanctioned blocking transfer lives
+  there;
+* lines carrying a ``# noqa: readback`` pragma (for a deliberate sync a
+  comment must justify).
+
+Exit status 1 with one ``path:line`` diagnostic per violation; 0 when clean.
+Wired into ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SERVING = REPO_ROOT / "accelerate_tpu" / "serving"
+EXEMPT_FILES = ("readback.py",)
+PRAGMA = "noqa: readback"
+BLOCKING_NAMES = ("device_get", "block_until_ready")
+
+
+def _name_of(node: ast.AST) -> str:
+    """Trailing identifier of a Name / dotted Attribute, '' otherwise."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def check_file(path: Path) -> list:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:  # quality target also runs compileall; be loud
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+    src_lines = source.splitlines()
+    violations = []
+    for node in ast.walk(tree):
+        # flag the attribute access itself, not just calls: passing
+        # ``arr.block_until_ready`` around blocks just as hard when invoked
+        if isinstance(node, ast.Call):
+            name = _name_of(node.func)
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        else:
+            continue
+        if name not in BLOCKING_NAMES:
+            continue
+        if PRAGMA in src_lines[node.lineno - 1]:
+            continue
+        rel = path.relative_to(REPO_ROOT)
+        violations.append(
+            f"{rel}:{node.lineno}: blocking readback ({name}) in the serving "
+            "hot path — route it through serving/readback.fetch (or justify "
+            "with '# noqa: readback')"
+        )
+    # one diagnostic per line: a Call and its Attribute func both match
+    return sorted(set(violations))
+
+
+def main() -> int:
+    violations = []
+    for path in sorted(SERVING.rglob("*.py")):
+        if path.name in EXEMPT_FILES:
+            continue
+        violations.extend(check_file(path))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"check_no_blocking_readback: {len(violations)} violation(s)")
+        return 1
+    print("check_no_blocking_readback: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
