@@ -1,7 +1,7 @@
 # Developer targets (reference Makefile:25-72 test split analog).
 
 .PHONY: test test_fast test_slow test_core test_big_modeling test_cli test_examples \
-        test_multiprocess test_kernels native bench bench-serve quality
+        test_multiprocess test_kernels native bench bench-serve quality lint-json
 
 test:
 	python -m pytest tests/ -q
@@ -55,12 +55,12 @@ bench-serve:
 	python bench_inference.py --task serve --async-ab
 	python bench_inference.py --task spec
 
+# one process, one AST load per file, all ten rules (tools/atpu_lint/rules/);
+# the lint surface includes the linter itself (docs/development/static-analysis.md)
 quality:
 	python -m compileall -q accelerate_tpu
-	python tools/check_reference_citations.py
-	python tools/check_no_bare_print.py
-	python tools/check_no_blocking_readback.py
-	python tools/check_no_method_lru_cache.py
-	python tools/check_pallas_interpret.py
-	python tools/check_metric_docs.py
-	python tools/check_sharding_annotations.py
+	python -m tools.atpu_lint accelerate_tpu tests tools bench.py bench_inference.py
+
+# machine-readable report for CI artifacts / editor integration
+lint-json:
+	@python -m tools.atpu_lint accelerate_tpu tests tools bench.py bench_inference.py --format json

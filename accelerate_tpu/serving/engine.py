@@ -1043,6 +1043,11 @@ class ServingEngine:
                     raise RuntimeError("KV page pool exhausted during copy-on-write")
                 continue
             kv = self.kv
+            # admission runs under the previous step's in-flight window, which
+            # consumes these page handles: park them until its drain so the
+            # rebind below never drops a consumed handle (see _stale_handles)
+            self._stale_handles += [kv.pages_k, kv.pages_v,
+                                    kv.k_scales, kv.v_scales]
             with self.tracer.span("serve/copy_page", src=pid, dst=new[0]):
                 kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales = self._copy_page(
                     kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
@@ -1071,6 +1076,9 @@ class ServingEngine:
                 "serve/insert", self._insert,
                 (self.pool, self.scratch.k, self.scratch.v, slot_i, length_i),
             )
+            # the in-flight window (if any) consumes the current pool handle;
+            # park it until drain rather than dropping it with the rebind
+            self._stale_handles.append(self.pool)
             self.pool = self._insert(
                 self.pool, self.scratch.k, self.scratch.v, slot_i, length_i,
             )

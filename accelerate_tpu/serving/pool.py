@@ -72,7 +72,7 @@ class ServeShardings:
     Factories take ``shardings=None`` (single-chip, plain ``jax.jit``) or an
     instance of this class, in which case every executable compiles with
     explicit in/out shardings — donated KV buffers alias in place per shard,
-    and :mod:`tools.check_sharding_annotations` pins the discipline.
+    and atpu-lint's ``sharding-annotations`` rule pins the discipline.
     """
 
     def __init__(self, mesh, params, tp_axis: str = "tp"):
@@ -99,7 +99,7 @@ def _serve_jit(fn, *, donate_argnums=(), in_shardings=None, out_shardings=None):
     single-chip: compile without placement constraints (committed inputs keep
     their devices, exactly the pre-mesh behavior)."""
     if in_shardings is None and out_shardings is None:
-        return jax.jit(fn, donate_argnums=donate_argnums)  # noqa: sharding (single-chip)
+        return jax.jit(fn, donate_argnums=donate_argnums)  # noqa: sharding-annotations (single-chip)
     return jax.jit(
         fn,
         donate_argnums=donate_argnums,

@@ -11,7 +11,7 @@ blocking :func:`fetch` returns as soon as the *old* window finishes while the
 new one keeps the device busy under the host's emit/scheduling work.
 
 :func:`fetch` is the ONE sanctioned blocking device->host transfer in the
-serving hot path — ``tools/check_no_blocking_readback.py`` lints every other
+serving hot path — atpu-lint's ``blocking-readback`` rule lints every other
 ``jax.device_get`` / ``block_until_ready`` out of ``accelerate_tpu/serving``
 so a stray eager readback cannot silently re-serialize the pipeline.
 """
@@ -36,7 +36,7 @@ def fetch(*arrays):
     tokens also guarantees its KV writes have landed — the invariant the
     deferred page release in :meth:`Readback.settle` relies on.
     """
-    out = tuple(np.asarray(jax.device_get(a)) for a in arrays)  # noqa: readback
+    out = tuple(np.asarray(jax.device_get(a)) for a in arrays)  # noqa: blocking-readback
     return out[0] if len(out) == 1 else out
 
 

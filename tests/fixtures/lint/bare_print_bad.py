@@ -1,0 +1,11 @@
+"""bare-print: library helper printing directly — two violations."""
+
+
+def helper(x):
+    print("debug:", x)
+    return x
+
+
+class Reporter:
+    def emit(self, msg):
+        print(msg)

@@ -1,0 +1,16 @@
+"""bare-print clean: entry points and the logger channel are exempt."""
+
+logger = object()
+
+
+def helper(x):
+    return x
+
+
+def main():
+    print("entry functions may print")
+
+
+if __name__ == "__main__":
+    print("so may the __main__ guard")
+    main()

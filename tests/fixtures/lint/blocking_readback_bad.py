@@ -1,0 +1,10 @@
+"""blocking-readback: eager device->host syncs in the hot path — three
+flagged lines (call, method call, and a bare attribute reference)."""
+import jax
+
+
+def drain(toks, pool):
+    host = jax.device_get(toks)
+    pool.block_until_ready()
+    waiter = pool.block_until_ready
+    return host, waiter

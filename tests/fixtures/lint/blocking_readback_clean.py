@@ -1,0 +1,6 @@
+"""blocking-readback clean: the sanctioned fetch() funnel."""
+from accelerate_tpu.serving.readback import fetch
+
+
+def drain(toks):
+    return fetch(toks)

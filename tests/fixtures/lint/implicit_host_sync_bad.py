@@ -1,0 +1,24 @@
+"""implicit-host-sync: quiet device->host conversions on a jitted
+executable's outputs — five violations (int, .item, np.asarray, iteration,
+truth-test)."""
+import numpy as np
+
+
+def _window(params, pool, lanes):
+    return pool, lanes
+
+
+class Engine:
+    def __init__(self):
+        self._decode = _serve_jit(_window, donate_argnums=(1,))  # noqa: F821
+
+    def loop(self, params, pool, lanes):
+        pool, toks = self._decode(params, pool, lanes)
+        first = int(toks[0])
+        scalar = toks.item()
+        host = np.asarray(toks)
+        for t in toks:
+            first += int(t is None)
+        if toks.any():
+            first += 1
+        return pool, first, scalar, host

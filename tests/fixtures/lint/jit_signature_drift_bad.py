@@ -1,0 +1,29 @@
+"""jit-signature-drift: call-varying shape scalars reaching jitted callees
+— five violations (drifting slice bound, sized constructor, drifting
+static_argnums positional, drifting static_argname keyword, bare drifting
+positional)."""
+import jax
+import jax.numpy as jnp
+
+
+def _fn(params, toks, width):
+    return toks
+
+
+step = jax.jit(_fn, static_argnums=(2,), in_shardings=None, out_shardings=None)
+step_kw = jax.jit(_fn, static_argnames=("width",), in_shardings=None,
+                  out_shardings=None)
+
+
+class Engine:
+    def __init__(self, bucket):
+        self._prefill = _serve_jit(make_prefill(bucket))  # noqa: F821 — stub
+
+    def admit(self, params, toks, chunk):
+        n = len(chunk)
+        out = self._prefill(params, toks[:n])
+        pad = self._prefill(params, jnp.zeros(n))
+        val = step(params, toks, n)
+        kwv = step_kw(params, toks, width=n)
+        raw = self._prefill(params, toks.shape[0])
+        return out, pad, val, kwv, raw

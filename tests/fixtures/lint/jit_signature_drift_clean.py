@@ -1,0 +1,21 @@
+"""jit-signature-drift clean: the repo's two sanctioned shapes.  Bucketing
+launders the drifting length into a padded size keying a dict of
+executables (`self._prefill[bucket]` — the subscript index never traces),
+and a scalar wrapped as a device array arrives traced, not staged into the
+signature."""
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self, buckets):
+        self._prefill = {
+            b: _serve_jit(make_prefill(b)) for b in buckets  # noqa: F821
+        }
+        self._decode = _serve_jit(make_decode(8))  # noqa: F821 — fixture stub
+
+    def admit(self, params, toks, chunk):
+        bucket = pad_to_bucket(len(chunk))  # noqa: F821 — fixture stub
+        out = self._prefill[bucket](params, toks)
+        k = jnp.int32(len(chunk))
+        val = self._decode(params, toks, k)
+        return out, val

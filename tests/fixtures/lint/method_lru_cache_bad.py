@@ -1,0 +1,12 @@
+"""method-lru-cache: caches keyed on self — two violations."""
+import functools
+
+
+class Planner:
+    @functools.lru_cache(maxsize=None)
+    def plan(self, shape):
+        return shape
+
+    @functools.cache
+    def layout(self, mesh):
+        return mesh

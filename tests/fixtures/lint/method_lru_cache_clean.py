@@ -1,0 +1,19 @@
+"""method-lru-cache clean: module functions, staticmethods, and
+cached_property are all fine."""
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def plan(shape):
+    return shape
+
+
+class Planner:
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def static_plan(shape):
+        return shape
+
+    @functools.cached_property
+    def mesh(self):
+        return object()

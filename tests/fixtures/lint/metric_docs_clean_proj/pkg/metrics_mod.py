@@ -1,0 +1,8 @@
+"""metric-docs clean project: every registration documented, every doc row
+emitted (literally or via the f-string family)."""
+
+
+def register(registry):
+    registry.counter("train/steps_total", help="documented")
+    for k in ("drafted", "accepted"):
+        registry.counter(f"serve/{k}_total", help="dynamic family")

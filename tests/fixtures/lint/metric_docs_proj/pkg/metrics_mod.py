@@ -1,0 +1,9 @@
+"""metric-docs bad project: one undocumented registration (the gauge) and
+one orphan doc row (`serve/gone_gauge` in the doc's metric table)."""
+
+
+def register(registry):
+    registry.counter("train/steps_total", help="documented")
+    registry.gauge("serve/queue_depth", help="NOT documented")
+    for k in ("drafted", "accepted"):
+        registry.counter(f"serve/{k}_total", help="dynamic family")

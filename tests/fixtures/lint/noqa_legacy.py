@@ -1,0 +1,14 @@
+"""Legacy pragma shim: the pre-framework bare forms still suppress their
+rule, but the runner emits a migration warning (not a failure)."""
+import jax
+
+
+def _fn(x):
+    return x
+
+
+def drain(toks):
+    return jax.device_get(toks)  # noqa: readback
+
+
+step = jax.jit(_fn, donate_argnums=(0,))  # noqa: sharding (fixture single-chip)

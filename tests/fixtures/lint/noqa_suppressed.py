@@ -1,0 +1,15 @@
+"""noqa handling: every violation here carries a suppressing pragma —
+single id, comma-separated multi-id, and with trailing commentary."""
+import functools
+
+
+def helper(x):
+    print("suppressed:", x)  # noqa: bare-print
+    print("multi:", x)  # noqa: jit-signature-drift,bare-print
+    return x
+
+
+class Planner:
+    @functools.lru_cache(maxsize=None)  # noqa: method-lru-cache (fixture: pinning the escape)
+    def plan(self, shape):
+        return shape

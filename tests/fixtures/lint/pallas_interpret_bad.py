@@ -1,0 +1,6 @@
+"""pallas-interpret: pallas_call without interpret= — one violation."""
+from jax.experimental import pallas as pl
+
+
+def run(kernel, x, shape):
+    return pl.pallas_call(kernel, out_shape=shape)(x)

@@ -1,0 +1,11 @@
+"""pallas-interpret clean: explicit interpret= or a **kwargs splat (the
+flag may arrive dynamically)."""
+from jax.experimental import pallas as pl
+
+
+def run(kernel, x, shape, interpret):
+    return pl.pallas_call(kernel, out_shape=shape, interpret=interpret)(x)
+
+
+def run_splat(kernel, x, **kw):
+    return pl.pallas_call(kernel, **kw)(x)

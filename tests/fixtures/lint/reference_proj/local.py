@@ -1,0 +1,3 @@
+"""Local citation target for the GENERIC resolver (3 lines long)."""
+X = 1
+Y = 2

@@ -1,0 +1,6 @@
+"""Citation fixtures that must rot-detect — three violations.
+
+Ports reference `missing.py:10` (no such file in the reference tree) and
+reference `utils.py:999` (line past EOF), plus a generic self-citation
+`local.py:40` whose line also runs past EOF.
+"""

@@ -1,0 +1,4 @@
+"""Citation fixtures that resolve — zero violations.
+
+Mirrors reference `utils.py:2-4` and the in-repo helper `local.py:2`.
+"""

@@ -1,0 +1,5 @@
+"""Reference-tree citation target (5 lines long)."""
+A = 1
+B = 2
+C = 3
+D = 4

@@ -1,0 +1,11 @@
+"""sharding-annotations: jit/pjit without shardings — two violations."""
+import jax
+from jax.experimental.pjit import pjit
+
+
+def _fn(x):
+    return x
+
+
+step = jax.jit(_fn, donate_argnums=(0,))
+other = pjit(_fn)

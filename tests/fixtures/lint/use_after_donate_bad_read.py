@@ -1,0 +1,16 @@
+"""use-after-donate (read-after-donate): `kv` is donated at position 1 and
+read again after dispatch — one violation on the `kv.sum()` line."""
+import jax
+
+
+def _step(params, kv):
+    return kv
+
+
+step = jax.jit(_step, donate_argnums=(1,), in_shardings=None, out_shardings=None)
+
+
+def run(params, kv):
+    out = step(params, kv)
+    total = kv.sum()
+    return out, total

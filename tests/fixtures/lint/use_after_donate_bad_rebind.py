@@ -1,0 +1,21 @@
+"""use-after-donate (dropped-handle): minimized from
+``accelerate_tpu/serving/engine.py::_decode_cycle`` with the PR-9 parking
+fix reverted.  The donate-and-rebind drops the old page handles while the
+previously dispatched window may still consume them — dropping the last
+reference blocks until that window retires, silently re-serializing the
+depth-1 pipeline.  One violation, on the rebind line."""
+
+
+class Engine:
+    def __init__(self, bucket):
+        self._decode = RecompileWatchdog(  # noqa: F821 — fixture stub
+            make_paged_decode_window(bucket), max_compiles=2  # noqa: F821
+        )
+
+    def decode_cycle(self, lanes):
+        kv = self.kv
+        tables = self._put(kv.tables)
+        kv.pages_k, kv.pages_v, toks = self._decode(
+            self.params, kv.pages_k, kv.pages_v, tables, lanes
+        )
+        return Readback(toks=toks)  # noqa: F821 — fixture stub

@@ -1,0 +1,37 @@
+"""use-after-donate clean: the same dispatch shapes, made safe.
+
+``decode_cycle`` parks the consumed handles into a surviving binding before
+the rebind (the PR-9 fix: they ride out on the window's Readback and die
+only after its drain).  ``prefill_sync`` instead drains synchronously with
+``fetch`` — no window escapes the function in flight, so the rebind can't
+strand a consumer."""
+import jax
+
+
+def _step(params, kv):
+    return kv
+
+
+step = jax.jit(_step, donate_argnums=(1,), in_shardings=None, out_shardings=None)
+
+
+class Engine:
+    def __init__(self, bucket):
+        self._decode = RecompileWatchdog(  # noqa: F821 — fixture stub
+            make_paged_decode_window(bucket), max_compiles=2  # noqa: F821
+        )
+
+    def decode_cycle(self, lanes):
+        kv = self.kv
+        consumed = [kv.pages_k, kv.pages_v]
+        tables = self._put(kv.tables)
+        kv.pages_k, kv.pages_v, toks = self._decode(
+            self.params, kv.pages_k, kv.pages_v, tables, lanes
+        )
+        return Readback(toks=toks, consumed=consumed)  # noqa: F821
+
+    def prefill_sync(self, params, kv):
+        kv = step(params, kv)
+        qerr = self._decode(params, kv.pages_k, kv.pages_v)
+        self.gauge.set(float(fetch(qerr)))  # noqa: F821 — fixture stub
+        return kv

@@ -1,0 +1,292 @@
+"""Golden tests for tools.atpu_lint: every rule pinned against a known-bad
+and known-clean fixture under tests/fixtures/lint/, plus the framework's
+noqa handling, legacy-pragma shim, baseline round-trip, and CLI surface.
+
+Tier-1, CPU-only: nothing here imports jax — the lint framework is pure ast
+by design, and these tests hold it to that.
+"""
+
+import io
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.atpu_lint import Project, Runner, get_rules  # noqa: E402
+from tools.atpu_lint.baseline import load_baseline, write_baseline  # noqa: E402
+from tools.atpu_lint.cli import main as lint_main  # noqa: E402
+from tools.atpu_lint.noqa import parse_noqa  # noqa: E402
+from tools.atpu_lint.rules import ALL_RULES  # noqa: E402
+
+FIX = REPO / "tests" / "fixtures" / "lint"
+
+EXPECTED_RULE_IDS = {
+    "bare-print",
+    "blocking-readback",
+    "method-lru-cache",
+    "pallas-interpret",
+    "metric-docs",
+    "sharding-annotations",
+    "reference-citations",
+    "use-after-donate",
+    "implicit-host-sync",
+    "jit-signature-drift",
+}
+
+
+def run_rules(rule_ids, paths, root=FIX, baseline=None, **project_kw):
+    project = Project(root=root, **project_kw)
+    runner = Runner(get_rules(rule_ids), project, baseline)
+    return runner.run([Path(p) for p in paths], force=True)
+
+
+# --------------------------------------------------------------- registry
+
+def test_registry_is_complete():
+    assert {cls.id for cls in ALL_RULES} == EXPECTED_RULE_IDS
+    assert all(cls.summary for cls in ALL_RULES)
+
+
+# ------------------------------------------------------ per-rule goldens
+
+@pytest.mark.parametrize(
+    "rule_id, bad, n_bad, clean",
+    [
+        ("bare-print", "bare_print_bad.py", 2, "bare_print_clean.py"),
+        ("blocking-readback", "blocking_readback_bad.py", 3,
+         "blocking_readback_clean.py"),
+        ("method-lru-cache", "method_lru_cache_bad.py", 2,
+         "method_lru_cache_clean.py"),
+        ("pallas-interpret", "pallas_interpret_bad.py", 1,
+         "pallas_interpret_clean.py"),
+        ("sharding-annotations", "sharding_annotations_bad.py", 2,
+         "sharding_annotations_clean.py"),
+        ("implicit-host-sync", "implicit_host_sync_bad.py", 5,
+         "implicit_host_sync_clean.py"),
+        ("jit-signature-drift", "jit_signature_drift_bad.py", 5,
+         "jit_signature_drift_clean.py"),
+    ],
+)
+def test_rule_golden(rule_id, bad, n_bad, clean):
+    report = run_rules([rule_id], [bad])
+    assert len(report.diagnostics) == n_bad, [d.render() for d in report.diagnostics]
+    assert all(d.rule == rule_id for d in report.diagnostics)
+    report = run_rules([rule_id], [clean])
+    assert report.diagnostics == [], [d.render() for d in report.diagnostics]
+
+
+def test_use_after_donate_read_after_donate():
+    report = run_rules(["use-after-donate"], ["use_after_donate_bad_read.py"])
+    assert len(report.diagnostics) == 1
+    d = report.diagnostics[0]
+    assert "'kv' was donated" in d.message and "read here" in d.message
+
+
+def test_use_after_donate_dropped_handle_minimized_pr9_repro():
+    """The minimized _decode_cycle with the parking fix reverted: the
+    donate-and-rebind line itself is the violation."""
+    report = run_rules(["use-after-donate"], ["use_after_donate_bad_rebind.py"])
+    assert len(report.diagnostics) == 1
+    d = report.diagnostics[0]
+    assert d.line == 18
+    assert "kv.pages_k" in d.message and "kv.pages_v" in d.message
+    assert "re-serializes the pipeline" in d.message
+
+
+def test_use_after_donate_clean_parked_and_drained():
+    report = run_rules(["use-after-donate"], ["use_after_donate_clean.py"])
+    assert report.diagnostics == [], [d.render() for d in report.diagnostics]
+
+
+def test_metric_docs_both_directions():
+    root = FIX / "metric_docs_proj"
+    report = run_rules(["metric-docs"], ["pkg"], root=root)
+    rendered = sorted(d.render() for d in report.diagnostics)
+    assert len(rendered) == 2, rendered
+    # forward: registered but undocumented
+    assert "serve/queue_depth" in rendered[1] and "not documented" in rendered[1]
+    # reverse (the fixed asymmetry): documented but no longer emitted —
+    # reported against the doc, not a source file
+    assert rendered[0].startswith("docs/usage/observability.md:")
+    assert "orphan doc row" in rendered[0] and "serve/gone_gauge" in rendered[0]
+    # f-string families cover their concrete doc rows; `*` rows are patterns
+    assert not any("serve/drafted_total" in r or "serve/decode_" in r
+                   for r in rendered)
+
+
+def test_metric_docs_clean():
+    root = FIX / "metric_docs_clean_proj"
+    report = run_rules(["metric-docs"], ["pkg"], root=root)
+    assert report.diagnostics == [], [d.render() for d in report.diagnostics]
+
+
+def test_reference_citations_golden():
+    root = FIX / "reference_proj"
+    report = run_rules(["reference-citations"], ["pkg"], root=root,
+                       reference_root=root / "reference")
+    by_file = {}
+    for d in report.diagnostics:
+        by_file.setdefault(Path(d.path).name, []).append(d)
+    assert len(by_file.get("cite_bad.py", [])) == 3, \
+        [d.render() for d in report.diagnostics]
+    assert "cite_clean.py" not in by_file
+    messages = " ".join(d.message for d in by_file["cite_bad.py"])
+    assert "missing.py" in messages and "past EOF" in messages
+
+
+def test_reference_citations_skips_when_tree_absent():
+    root = FIX / "reference_proj"
+    report = run_rules(["reference-citations"], ["pkg"], root=root,
+                       reference_root=root / "no_such_tree")
+    assert report.diagnostics == []
+    assert any("skipping reference-citations" in w for w in report.warnings)
+
+
+# --------------------------------------------------------- noqa handling
+
+def test_noqa_suppresses_including_comma_multi_id():
+    report = run_rules(["bare-print", "method-lru-cache"], ["noqa_suppressed.py"])
+    assert report.diagnostics == [], [d.render() for d in report.diagnostics]
+    assert report.suppressed == 3
+
+
+def test_legacy_pragma_shim_warns_but_suppresses():
+    report = run_rules(["blocking-readback", "sharding-annotations"],
+                       ["noqa_legacy.py"])
+    assert report.diagnostics == [], [d.render() for d in report.diagnostics]
+    assert report.suppressed == 2
+    legacy_warnings = [w for w in report.warnings if "legacy" in w]
+    assert len(legacy_warnings) == 2
+    assert any("blocking-readback" in w for w in legacy_warnings)
+    assert any("sharding-annotations" in w for w in legacy_warnings)
+
+
+def test_parse_noqa_dialect():
+    ids, legacy = parse_noqa("x = 1  # noqa: bare-print, use-after-donate")
+    assert ids == {"bare-print", "use-after-donate"} and legacy == []
+    # legacy bare forms map to canonical ids and are reported for migration
+    # (the pragma strings are split so this very file doesn't carry them)
+    ids, legacy = parse_noqa("y = f()  # noqa" + ": readback")
+    assert ids == {"blocking-readback"} and legacy == ["readback"]
+    ids, legacy = parse_noqa("z = g()  # noqa" + ": sharding (single-chip)")
+    assert ids == {"sharding-annotations"} and legacy == ["sharding"]
+    # a bare `# noqa` (no code list) is ignored — blanket suppression hides
+    # too much for perf-invariant rules
+    assert parse_noqa("w = 2  # noqa") == (set(), [])
+
+
+# ------------------------------------------------------------- baseline
+
+def test_baseline_round_trip_and_line_churn_stability(tmp_path):
+    src = (FIX / "bare_print_bad.py").read_text()
+    target = tmp_path / "bare_print_bad.py"
+    target.write_text(src)
+    report = run_rules(["bare-print"], [target.name], root=tmp_path)
+    assert len(report.diagnostics) == 2
+    bl_path = tmp_path / "baseline.json"
+    assert write_baseline(bl_path, report.diagnostics) == 2
+
+    baseline = load_baseline(bl_path)
+    report = run_rules(["bare-print"], [target.name], root=tmp_path,
+                       baseline=baseline)
+    assert report.diagnostics == [] and len(report.baselined) == 2
+
+    # fingerprints key on the stripped source line, not the line number:
+    # unrelated churn above the finding keeps the baseline entry valid
+    target.write_text("# an unrelated leading comment\n" + src)
+    report = run_rules(["bare-print"], [target.name], root=tmp_path,
+                       baseline=baseline)
+    assert report.diagnostics == [] and len(report.baselined) == 2
+
+
+def test_malformed_baseline_rejected(tmp_path):
+    bad = tmp_path / "bl.json"
+    bad.write_text(json.dumps({"version": 99, "entries": {}}))
+    with pytest.raises(ValueError):
+        load_baseline(bad)
+
+
+def test_parse_error_is_unsuppressable(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:  # noqa: parse\n    pass\n")
+    report = run_rules(["bare-print"], [broken.name], root=tmp_path)
+    assert len(report.diagnostics) == 1
+    assert report.diagnostics[0].rule == "parse"
+    assert report.exit_code == 1
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_cli_list_rules():
+    out = io.StringIO()
+    assert lint_main(["--list-rules"], stdout=out) == 0
+    listed = {line.split()[0] for line in out.getvalue().splitlines()}
+    assert listed == EXPECTED_RULE_IDS
+
+
+def test_cli_unknown_select_is_usage_error():
+    out, err = io.StringIO(), io.StringIO()
+    assert lint_main(["--select", "no-such-rule"], stdout=out, stderr=err) == 2
+    assert "no-such-rule" in err.getvalue()
+
+
+def test_cli_scoping_findings_and_baseline_flow(tmp_path):
+    """End-to-end through real path scoping: a violation in a mimicked
+    serving/ layout fires, --write-baseline grandfathers it, the next run is
+    clean."""
+    pkg = tmp_path / "accelerate_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    pkg.joinpath("hot.py").write_text(textwrap.dedent("""\
+        import jax
+
+
+        def drain(toks):
+            return jax.device_get(toks)
+    """))
+    out, err = io.StringIO(), io.StringIO()
+    rc = lint_main(["accelerate_tpu"], root=tmp_path, stdout=out, stderr=err)
+    assert rc == 1
+    assert "[blocking-readback]" in out.getvalue()
+
+    rc = lint_main(["accelerate_tpu", "--write-baseline", "--baseline", "bl.json"],
+                   root=tmp_path, stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0 and (tmp_path / "bl.json").exists()
+
+    out = io.StringIO()
+    rc = lint_main(["accelerate_tpu", "--baseline", "bl.json"],
+                   root=tmp_path, stdout=out, stderr=io.StringIO())
+    assert rc == 0
+    assert "1 baselined" in out.getvalue()
+
+
+def test_cli_json_format(tmp_path):
+    out = io.StringIO()
+    rc = lint_main(["tools/atpu_lint", "--format", "json", "--no-baseline"],
+                   root=REPO, stdout=out, stderr=io.StringIO())
+    payload = json.loads(out.getvalue())
+    assert rc == 0 and payload["findings"] == []
+    assert payload["files_checked"] > 0
+
+
+# ------------------------------------------------- repo-level invariants
+
+def test_repo_default_surface_is_lint_clean():
+    """The acceptance bar: the exact invocation `make quality` runs exits 0
+    against the committed tree (with the committed — empty — baseline)."""
+    out, err = io.StringIO(), io.StringIO()
+    rc = lint_main([], root=REPO, stdout=out, stderr=err)
+    assert rc == 0, out.getvalue() + err.getvalue()
+    # and with no legacy pragmas left in-tree, the only tolerated warning is
+    # the absent reference checkout
+    assert not any("legacy" in w for w in err.getvalue().splitlines())
+
+
+def test_committed_baseline_is_empty():
+    data = json.loads((REPO / "tools" / "atpu_lint" / "baseline.json").read_text())
+    assert data == {"version": 1, "entries": {}}

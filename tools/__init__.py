@@ -1,0 +1,2 @@
+"""Developer tooling for the accelerate_tpu repo (lint framework lives in
+``tools/atpu_lint``; run it with ``python -m tools.atpu_lint``)."""

@@ -1,0 +1,21 @@
+"""atpu-lint: unified AST/dataflow lint framework for the accelerate_tpu tree.
+
+One shared AST load per file, a ``Rule`` plugin protocol, unified ``# noqa``
+handling, text/JSON output, and an optional committed baseline.  Run it with
+``python -m tools.atpu_lint`` (see ``docs/development/static-analysis.md``).
+"""
+
+from .core import Diagnostic, FileContext, Project, Report, Rule, Runner
+from .rules import ALL_RULES, RULES_BY_ID, get_rules
+
+__all__ = [
+    "ALL_RULES",
+    "Diagnostic",
+    "FileContext",
+    "Project",
+    "Report",
+    "Rule",
+    "Runner",
+    "RULES_BY_ID",
+    "get_rules",
+]

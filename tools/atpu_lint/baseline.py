@@ -1,0 +1,62 @@
+"""Committed baseline for grandfathered findings.
+
+A baseline entry suppresses one diagnostic by fingerprint (rule id + path +
+stripped source line, so line-number churn doesn't invalidate it).  The
+intended lifecycle: a new rule lands with real pre-existing findings, they
+are written to the baseline with ``--write-baseline`` (every entry carries a
+``note`` — seeded entries must say what tracks the cleanup), and the count
+only ever goes down.  The default run loads ``tools/atpu_lint/baseline.json``
+when it exists; the repo's checked-in baseline is empty.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List
+
+from .core import Diagnostic
+
+__all__ = ["DEFAULT_BASELINE", "load_baseline", "write_baseline"]
+
+DEFAULT_BASELINE = "tools/atpu_lint/baseline.json"
+_VERSION = 1
+
+
+def load_baseline(path: Path) -> Dict[str, dict]:
+    """fingerprint -> entry dict.  Raises ``ValueError`` on a malformed or
+    future-versioned file (a silently ignored baseline would unsuppress or
+    oversuppress everything)."""
+    data = json.loads(path.read_text())
+    if not isinstance(data, dict) or data.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline format in {path}")
+    entries = data.get("entries", {})
+    if not isinstance(entries, dict):
+        raise ValueError(f"malformed baseline entries in {path}")
+    return entries
+
+
+def write_baseline(path: Path, diagnostics: Iterable[Diagnostic],
+                   note: str = "TODO: triage (seeded by --write-baseline)") -> int:
+    """Serialize ``diagnostics`` as the new baseline; returns the entry count."""
+    entries = {}
+    for diag in diagnostics:
+        entries[diag.fingerprint] = {
+            "rule": diag.rule,
+            "path": diag.path,
+            "line": diag.line,
+            "note": note,
+        }
+    payload = {"version": _VERSION, "entries": entries}
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    return len(entries)
+
+
+def empty_baseline() -> dict:
+    return {"version": _VERSION, "entries": {}}
+
+
+def baseline_notes_missing(entries: Dict[str, dict]) -> List[str]:
+    """Fingerprints whose entry lacks a tracking note (policy: every seeded
+    baseline entry must say what tracks its cleanup)."""
+    return [fp for fp, e in sorted(entries.items()) if not str(e.get("note", "")).strip()]

@@ -1,0 +1,247 @@
+"""atpu-lint core: one shared AST load per file, a ``Rule`` plugin protocol,
+and the runner that fans every parsed tree out to all applicable rules.
+
+The previous generation of this tooling was seven single-rule scripts, each
+re-reading and re-parsing the whole package with its own walker and its own
+``# noqa`` dialect — seven interpreter startups per ``make quality``.  Here a
+file is read once, parsed once, its noqa pragmas extracted once, and every
+registered rule visits the same tree.  Rules are plain objects:
+
+* ``id`` — kebab-case rule id, the ``# noqa:`` escape token;
+* ``applies_to(rel)`` — path scoping (repo-root-relative posix path);
+* ``visit(tree, src, ctx)`` — per-file pass returning ``Diagnostic``s;
+* ``finalize(project)`` — optional cross-file pass after every visit (used
+  by rules that aggregate project-wide state, e.g. metric-docs' orphan-row
+  check).
+
+Diagnostics are suppressed by line-level ``# noqa: <rule-id>`` pragmas
+(:mod:`tools.atpu_lint.noqa`) and by a committed baseline of fingerprints
+(:mod:`tools.atpu_lint.baseline`) for grandfathered findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from .noqa import file_noqa_map
+
+__all__ = ["Diagnostic", "FileContext", "Project", "Report", "Rule", "Runner"]
+
+#: directories never linted, wherever they appear
+_SKIP_DIR_NAMES = {"__pycache__", ".git", ".venv", "node_modules"}
+#: repo-relative prefixes never linted (fixture files are violations on purpose)
+_SKIP_REL_PREFIXES = ("tests/fixtures/lint/",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: ``path:line: [rule] message``.  ``src_line`` is the
+    stripped source text of the flagged line — the fingerprint keys on it so
+    baselines survive unrelated line-number churn."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    src_line: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        key = self.src_line.strip() or str(self.line)
+        digest = hashlib.sha1(
+            f"{self.rule}\x00{self.path}\x00{key}".encode()
+        ).hexdigest()
+        return digest[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class Rule:
+    """Plugin protocol.  Subclasses set ``id``/``summary``/``invariant`` and
+    override ``applies_to``/``visit`` (and ``finalize`` for cross-file
+    rules)."""
+
+    id: str = ""
+    #: one-line description for ``--list-rules``
+    summary: str = ""
+
+    def applies_to(self, rel: str) -> bool:
+        return True
+
+    def visit(self, tree: Optional[ast.Module], src: str, ctx: "FileContext") -> List[Diagnostic]:
+        return []
+
+    def finalize(self, project: "Project") -> List[Diagnostic]:
+        return []
+
+
+@dataclasses.dataclass
+class Project:
+    """Run-wide context: the repo root every ``rel`` path hangs off, plus the
+    handful of cross-tree locations rules need (the observability doc, the
+    upstream reference checkout).  Tests point ``root`` at fixture trees."""
+
+    root: Path
+    reference_root: Path = Path("/root/reference")
+    observability_doc: str = "docs/usage/observability.md"
+    files: List["FileContext"] = dataclasses.field(default_factory=list)
+    warnings: List[str] = dataclasses.field(default_factory=list)
+
+    def warn(self, message: str) -> None:
+        if message not in self.warnings:
+            self.warnings.append(message)
+
+    def rel(self, path: Path) -> str:
+        return path.resolve().relative_to(self.root.resolve()).as_posix()
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule may need about one file, computed exactly once."""
+
+    path: Path
+    rel: str
+    src: str
+    lines: List[str]
+    tree: Optional[ast.Module]
+    noqa: Dict[int, Set[str]]
+    legacy_noqa: Dict[int, List[str]]
+    project: Project
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+@dataclasses.dataclass
+class Report:
+    diagnostics: List[Diagnostic]
+    suppressed: int
+    baselined: List[Diagnostic]
+    warnings: List[str]
+    files_checked: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.diagnostics else 0
+
+
+def discover_files(paths: Sequence[Path], project: Project) -> List[Path]:
+    """Expand files/directories into the sorted set of lintable ``.py`` files
+    under the project root (fixture trees and cache dirs excluded)."""
+    out: Set[Path] = set()
+    for p in paths:
+        p = p if p.is_absolute() else project.root / p
+        if p.is_dir():
+            for f in p.rglob("*.py"):
+                out.add(f)
+        elif p.suffix == ".py" and p.exists():
+            out.add(p)
+        elif not p.exists():
+            raise FileNotFoundError(f"atpu-lint: no such path: {p}")
+    kept = []
+    for f in sorted(out):
+        try:
+            rel = project.rel(f)
+        except ValueError:
+            raise ValueError(f"atpu-lint: {f} is outside the project root {project.root}")
+        if any(part in _SKIP_DIR_NAMES for part in Path(rel).parts):
+            continue
+        if any(rel.startswith(pre) for pre in _SKIP_REL_PREFIXES):
+            continue
+        kept.append(f)
+    return kept
+
+
+class Runner:
+    """Load each file once, run every applicable rule over the shared tree,
+    then apply noqa suppression and the baseline."""
+
+    def __init__(self, rules: Sequence[Rule], project: Project,
+                 baseline: Optional[Dict[str, dict]] = None):
+        self.rules = list(rules)
+        self.project = project
+        self.baseline = baseline or {}
+        self.rule_ids = {r.id for r in self.rules}
+
+    def run(self, paths: Sequence[Path], force: bool = False) -> Report:
+        files = discover_files(paths, self.project)
+        raw: List[Diagnostic] = []
+        ctx_by_rel: Dict[str, FileContext] = {}
+        for path in files:
+            ctx = self._load(path)
+            ctx_by_rel[ctx.rel] = ctx
+            self.project.files.append(ctx)
+            if ctx.tree is None:
+                continue  # the parse diagnostic was already recorded
+            for rule in self.rules:
+                if force or rule.applies_to(ctx.rel):
+                    raw.extend(rule.visit(ctx.tree, ctx.src, ctx))
+        for rule in self.rules:
+            raw.extend(rule.finalize(self.project))
+        return self._filter(raw, ctx_by_rel, len(files))
+
+    def _load(self, path: Path) -> FileContext:
+        src = path.read_text()
+        rel = self.project.rel(path)
+        noqa, legacy = file_noqa_map(src)
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as exc:
+            tree = None
+            # surfaced as an unsuppressable diagnostic: make quality also
+            # runs compileall, be equally loud here
+            self._parse_errors = getattr(self, "_parse_errors", [])
+            self._parse_errors.append(
+                Diagnostic(rel, exc.lineno or 1, "parse",
+                           f"syntax error: {exc.msg}")
+            )
+        ctx = FileContext(path, rel, src, src.splitlines(), tree, noqa, legacy, self.project)
+        return ctx
+
+    def _filter(self, raw: Iterable[Diagnostic], ctx_by_rel: Dict[str, FileContext],
+                files_checked: int) -> Report:
+        kept: List[Diagnostic] = []
+        baselined: List[Diagnostic] = []
+        suppressed = 0
+        for diag in raw:
+            ctx = ctx_by_rel.get(diag.path)
+            if not diag.src_line and ctx is not None:
+                diag = dataclasses.replace(diag, src_line=ctx.line_text(diag.line))
+            if ctx is not None and diag.rule in ctx.noqa.get(diag.line, ()):
+                suppressed += 1
+                continue
+            if diag.fingerprint in self.baseline:
+                baselined.append(diag)
+                continue
+            kept.append(diag)
+        kept.extend(getattr(self, "_parse_errors", []))
+        # legacy-pragma migration warnings (honored this release, then gone)
+        for ctx in ctx_by_rel.values():
+            for lineno, forms in sorted(ctx.legacy_noqa.items()):
+                for form in forms:
+                    from .noqa import LEGACY_ALIASES
+
+                    self.project.warn(
+                        f"{ctx.rel}:{lineno}: legacy '# noqa: {form}' form — "
+                        f"use '# noqa: {LEGACY_ALIASES[form]}' (bare form is "
+                        "honored this release only)"
+                    )
+        kept.sort(key=lambda d: (d.path, d.line, d.rule))
+        return Report(kept, suppressed, baselined, list(self.project.warnings),
+                      files_checked)

@@ -1,0 +1,70 @@
+"""Unified ``# noqa: <rule-id>[,<rule-id>]`` handling.
+
+One dialect for every rule: a diagnostic on line N is suppressed when line N
+carries a ``# noqa:`` pragma naming the diagnostic's rule id.  Multiple ids
+are comma-separated; anything after the first whitespace inside an id token
+is commentary (``# noqa: sharding-annotations (single-chip)``).  Foreign
+codes (flake8's ``E402``, ``N802``, ...) are ignored — they neither suppress
+atpu-lint rules nor warn.  A bare ``# noqa`` with no code list is likewise
+ignored: blanket suppression hides too much for rules that guard perf
+invariants, so atpu-lint requires the rule id to be spelled out.
+
+Migration shim: before the framework existed, the single-rule scripts in
+``tools/`` each grew their own pragma dialect — ``# noqa: readback`` and
+``# noqa: sharding``.  Those legacy bare forms still suppress their rule for
+one release, but the runner emits a warning (not a failure) steering the
+author to the canonical rule id.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+__all__ = ["LEGACY_ALIASES", "parse_noqa", "file_noqa_map"]
+
+# legacy bare form -> canonical rule id (warn-but-honor for one release)
+LEGACY_ALIASES = {
+    "readback": "blocking-readback",
+    "sharding": "sharding-annotations",
+}
+
+_NOQA_RE = re.compile(r"#\s*noqa\s*:\s*(?P<codes>[^#]*)", re.IGNORECASE)
+_ID_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
+
+
+def parse_noqa(line: str) -> Tuple[Set[str], List[str]]:
+    """Rule ids suppressed by ``line``'s pragma (canonical form) plus any
+    legacy-form ids that were honored via :data:`LEGACY_ALIASES`."""
+    ids: Set[str] = set()
+    legacy: List[str] = []
+    for m in _NOQA_RE.finditer(line):
+        for token in m.group("codes").split(","):
+            word = token.strip().split(" ")[0].split("\t")[0]
+            if not word or not _ID_RE.match(word):
+                continue
+            if word in LEGACY_ALIASES:
+                ids.add(LEGACY_ALIASES[word])
+                legacy.append(word)
+            else:
+                ids.add(word)
+    return ids, legacy
+
+
+def file_noqa_map(src: str) -> Tuple[Dict[int, Set[str]], Dict[int, List[str]]]:
+    """Per-line suppression map for a whole file.
+
+    Returns ``(suppressions, legacy_uses)``: line number (1-based) -> set of
+    suppressed rule ids, and line number -> legacy bare forms found there.
+    """
+    suppress: Dict[int, Set[str]] = {}
+    legacy_uses: Dict[int, List[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        if "noqa" not in line:
+            continue
+        ids, legacy = parse_noqa(line)
+        if ids:
+            suppress[i] = ids
+        if legacy:
+            legacy_uses[i] = legacy
+    return suppress, legacy_uses

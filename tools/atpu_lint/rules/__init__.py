@@ -1,0 +1,49 @@
+"""Rule registry: one place every rule is declared, so the runner, the CLI's
+``--list-rules``/``--select``, the noqa validator, and the docs all agree on
+the rule set."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from ..core import Rule
+from .bare_print import BarePrintRule
+from .blocking_readback import BlockingReadbackRule
+from .implicit_host_sync import ImplicitHostSyncRule
+from .jit_signature_drift import JitSignatureDriftRule
+from .metric_docs import MetricDocsRule
+from .method_lru_cache import MethodLruCacheRule
+from .pallas_interpret import PallasInterpretRule
+from .reference_citations import ReferenceCitationsRule
+from .sharding_annotations import ShardingAnnotationsRule
+from .use_after_donate import UseAfterDonateRule
+
+#: declaration order is display order in --list-rules and the docs
+ALL_RULES: List[Type[Rule]] = [
+    BarePrintRule,
+    BlockingReadbackRule,
+    MethodLruCacheRule,
+    PallasInterpretRule,
+    MetricDocsRule,
+    ShardingAnnotationsRule,
+    ReferenceCitationsRule,
+    UseAfterDonateRule,
+    ImplicitHostSyncRule,
+    JitSignatureDriftRule,
+]
+
+RULES_BY_ID: Dict[str, Type[Rule]] = {cls.id: cls for cls in ALL_RULES}
+
+
+def get_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Fresh rule instances (rules keep per-run state), optionally narrowed
+    to the given ids.  Unknown ids raise ``KeyError`` with the valid set."""
+    if select is None:
+        return [cls() for cls in ALL_RULES]
+    unknown = [rid for rid in select if rid not in RULES_BY_ID]
+    if unknown:
+        raise KeyError(
+            f"unknown rule id(s): {', '.join(unknown)} — valid: "
+            f"{', '.join(sorted(RULES_BY_ID))}"
+        )
+    return [RULES_BY_ID[rid]() for rid in select]

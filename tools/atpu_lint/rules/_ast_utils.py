@@ -1,0 +1,360 @@
+"""Shared AST helpers for atpu-lint rules.
+
+Everything here is deliberately syntactic: atpu-lint runs with no jax import
+and no type inference, so "is this callee jitted?" means "was a name in this
+module visibly bound to a ``jax.jit`` / ``pjit`` / ``_serve_jit`` result (or
+wrapped in a ``RecompileWatchdog``)", and dataflow is a linear walk over a
+function's statements in source order with no branch sensitivity.  The
+golden fixtures in ``tests/fixtures/lint/`` pin exactly what these
+approximations catch.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+JIT_TAILS = ("jit", "pjit", "_serve_jit")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain of Names, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def tail_name(node: ast.AST) -> str:
+    """Trailing identifier of a Name / dotted Attribute, '' otherwise."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def literal_int_positions(node: Optional[ast.expr]) -> Optional[Tuple[int, ...]]:
+    """``donate_argnums=2`` / ``donate_argnums=(1, 2)`` -> positions, else None."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None
+
+
+def literal_str_names(node: Optional[ast.expr]) -> Tuple[str, ...]:
+    """``donate_argnames=("cache",)`` / ``"cache"`` -> names, else ()."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def entry_exempt_lines(tree: ast.Module,
+                       entry_funcs: Sequence[str] = ("main", "_main")) -> Set[int]:
+    """Line ranges inside entry-point functions and ``__main__`` guards."""
+    lines: Set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        end = getattr(node, "end_lineno", node.lineno)
+        lines.update(range(node.lineno, end + 1))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in entry_funcs:
+                mark(node)
+        elif isinstance(node, ast.If):
+            test = node.test
+            if (
+                isinstance(test, ast.Compare)
+                and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Eq)
+            ):
+                parts = [test.left] + list(test.comparators)
+                names = [p.id for p in parts if isinstance(p, ast.Name)]
+                consts = [p.value for p in parts if isinstance(p, ast.Constant)]
+                if "__name__" in names and "__main__" in consts:
+                    mark(node)
+    return lines
+
+
+@dataclasses.dataclass
+class JitTarget:
+    """One name visibly bound to a jit-compiled callable in this module."""
+
+    name: str                                   # dotted binding ("step", "self._decode")
+    donate_positions: Tuple[int, ...] = ()
+    donate_names: Tuple[str, ...] = ()
+    static_positions: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_positions or self.donate_names)
+
+
+def _unwrap_jit_call(value: ast.expr) -> Optional[ast.Call]:
+    """The ``jax.jit(...)``-shaped call inside ``value``, seeing through a
+    ``RecompileWatchdog(<call>, ...)`` wrapper, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    tail = tail_name(value.func)
+    if tail in JIT_TAILS:
+        return value
+    if tail == "RecompileWatchdog" and value.args and isinstance(value.args[0], ast.Call):
+        return _unwrap_jit_call(value.args[0])
+    return None
+
+
+def _jit_call_decorator(deco: ast.expr) -> Optional[ast.Call]:
+    """``@jax.jit`` / ``@partial(jax.jit, ...)`` -> the call carrying the jit
+    keywords (the partial call itself for the partial form)."""
+    if isinstance(deco, (ast.Name, ast.Attribute)) and tail_name(deco) in JIT_TAILS:
+        return None  # bare @jax.jit: jitted, but no keywords to read
+    if isinstance(deco, ast.Call):
+        if tail_name(deco.func) in JIT_TAILS:
+            return deco
+        if tail_name(deco.func) == "partial" and deco.args:
+            if tail_name(deco.args[0]) in JIT_TAILS:
+                return deco
+    return None
+
+
+def _target_from_call(name: str, call: Optional[ast.Call]) -> JitTarget:
+    kw = {k.arg: k.value for k in (call.keywords if call is not None else []) if k.arg}
+    return JitTarget(
+        name=name,
+        donate_positions=literal_int_positions(kw.get("donate_argnums")) or (),
+        donate_names=literal_str_names(kw.get("donate_argnames")),
+        static_positions=literal_int_positions(kw.get("static_argnums")) or (),
+        static_names=literal_str_names(kw.get("static_argnames")),
+    )
+
+
+def build_jit_index(tree: ast.Module) -> Dict[str, JitTarget]:
+    """name -> JitTarget for every binding this module visibly jit-compiles.
+
+    Recognized shapes (anywhere in the module, including method bodies):
+
+    * ``f = jax.jit(g, ...)`` / ``f = pjit(...)`` / ``f = _serve_jit(...)``
+    * ``self._attr = _serve_jit(...)`` (recorded under ``self._attr``)
+    * ``self._attr = RecompileWatchdog(_serve_jit(...), ...)``
+    * ``@jax.jit`` / ``@partial(jax.jit, donate_argnums=...)`` on a def
+    """
+    index: Dict[str, JitTarget] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            name = dotted(node.targets[0])
+            call = _unwrap_jit_call(node.value)
+            if name and call is not None:
+                index[name] = _target_from_call(name, call)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                is_bare = (
+                    isinstance(deco, (ast.Name, ast.Attribute))
+                    and tail_name(deco) in JIT_TAILS
+                )
+                call = _jit_call_decorator(deco)
+                if is_bare or call is not None:
+                    index[node.name] = _target_from_call(node.name, call)
+                    break
+    return index
+
+
+#: call tails that mark a binding as a device executable even without a
+#: visible jax.jit: the pool factory convention plus the watchdog wrapper
+EXEC_WRAPPER_TAILS = {"RecompileWatchdog"} | set(JIT_TAILS)
+
+
+def build_executable_index(tree: ast.Module) -> Set[str]:
+    """Dotted names visibly bound to device executables in this module.
+
+    Beyond the resolvable jit bindings of :func:`build_jit_index`, serving
+    code binds executables through wrappers the index can't see inside —
+    ``self._decode = RecompileWatchdog(make_paged_decode_window(...), ...)``,
+    dict comprehensions of per-bucket executables, conditional expressions.
+    A binding counts when its value subtree contains a call to ``jit`` /
+    ``pjit`` / ``_serve_jit`` / ``RecompileWatchdog`` or to a ``make_*`` pool
+    factory.  Calls through these names (including ``self._prefill[bucket]``
+    subscript dispatch) are treated as jitted dispatches by the dataflow
+    rules.
+    """
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        name = dotted(node.targets[0])
+        if not name:
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                tail = tail_name(sub.func)
+                if tail in EXEC_WRAPPER_TAILS or tail.startswith("make_"):
+                    names.add(name)
+                    break
+    return names
+
+
+def callee_executable_name(call: ast.Call) -> Optional[str]:
+    """The dotted binding a call dispatches through: ``self._decode(...)`` ->
+    ``self._decode``; ``self._prefill[bucket](...)`` -> ``self._prefill``."""
+    func = call.func
+    if isinstance(func, ast.Subscript):
+        return dotted(func.value)
+    return dotted(func)
+
+
+@dataclasses.dataclass
+class LinearStmt:
+    """One statement (or compound-statement header) in source order, with the
+    dotted names it loads and stores in its *own* expressions (nested block
+    bodies become their own LinearStmt entries)."""
+
+    node: ast.stmt
+    loads: Set[str]
+    stores: Set[str]
+    calls: List[ast.Call]
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+
+def _names_in(exprs: Sequence[Optional[ast.expr]], ctx_types) -> Set[str]:
+    out: Set[str] = set()
+    for expr in exprs:
+        if expr is None:
+            continue
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ctx_types
+            ):
+                name = dotted(node)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _calls_in(exprs: Sequence[Optional[ast.expr]]) -> List[ast.Call]:
+    out: List[ast.Call] = []
+    for expr in exprs:
+        if expr is None:
+            continue
+        out.extend(n for n in ast.walk(expr) if isinstance(n, ast.Call))
+    return out
+
+
+def _own_exprs(stmt: ast.stmt) -> Tuple[List[ast.expr], List[ast.expr]]:
+    """(value-side exprs, target-side exprs) belonging to the statement
+    itself, excluding nested statement blocks."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value], list(stmt.targets)
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target], [stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value], [stmt.target]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value], []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value], []
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test], []
+    if isinstance(stmt, ast.For):
+        return [stmt.iter], [stmt.target]
+    if isinstance(stmt, ast.With):
+        vals = [item.context_expr for item in stmt.items]
+        tgts = [item.optional_vars for item in stmt.items if item.optional_vars]
+        return vals, tgts
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test, stmt.msg], []
+    if isinstance(stmt, (ast.Raise,)):
+        return [stmt.exc, stmt.cause], []
+    if isinstance(stmt, ast.Delete):
+        return [], list(stmt.targets)
+    return [], []
+
+
+def linearize(fn: ast.AST) -> List[LinearStmt]:
+    """Flatten a function body into source-ordered LinearStmt records.
+    Nested function/class defs are skipped (they get their own analysis)."""
+    out: List[LinearStmt] = []
+
+    def visit_block(body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            values, targets = _own_exprs(stmt)
+            loads = _names_in(values, (ast.Load,))
+            # subscript/attribute stores also *load* their base (self.x[i] = v
+            # reads self.x); dotted() on a Store-ctx chain captures the name
+            stores = _names_in(targets, (ast.Store,))
+            loads |= _names_in(targets, (ast.Load,))
+            out.append(LinearStmt(stmt, loads, stores, _calls_in(values + targets)))
+            for block in ("body", "orelse", "finalbody"):
+                visit_block(getattr(stmt, block, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                visit_block(handler.body)
+
+    body = getattr(fn, "body", [])
+    visit_block(body)
+    return out
+
+
+def iter_functions(tree: ast.Module) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def call_arg_names(call: ast.Call, tuple_map: Dict[str, List[ast.expr]]) -> List[Optional[str]]:
+    """Dotted names of a call's positional args, expanding ``*args`` splats
+    through ``tuple_map`` (name -> tuple-literal elements assigned earlier in
+    the same function).  Non-name args yield None placeholders so positions
+    line up with ``donate_argnums``."""
+    out: List[Optional[str]] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            inner = dotted(arg.value)
+            elements = tuple_map.get(inner or "", [])
+            if elements:
+                out.extend(dotted(e) for e in elements)
+            else:
+                out.append(None)
+        else:
+            out.append(dotted(arg))
+    return out
+
+
+def tuple_literal_map(stmts: Sequence[LinearStmt]) -> Dict[str, List[ast.expr]]:
+    """name -> elements for simple ``name = (e1, e2, ...)`` assignments."""
+    out: Dict[str, List[ast.expr]] = {}
+    for ls in stmts:
+        node = ls.node
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            out[node.targets[0].id] = list(node.value.elts)
+    return out

@@ -1,0 +1,57 @@
+"""Rule ``bare-print``: no bare ``print(`` in library code.
+
+Library output must go through ``accelerate_tpu.logging.get_logger`` (rank-
+aware, level-filtered, dedupe-capable) or ``PartialState.print`` (the
+deliberate main-process print channel) — a stray ``print`` in the train or
+serve path emits once per host process and cannot be silenced.
+
+Exempt: ``accelerate_tpu/test_utils/`` and ``accelerate_tpu/commands/``
+(CLI + test harness surfaces print by design); any ``__main__.py``; code
+inside ``main`` / ``_main`` functions or ``if __name__ == "__main__":``
+blocks (script entry points); lines carrying ``# noqa: bare-print``.
+
+Ported from ``tools/check_no_bare_print.py``; the rule now also covers the
+lint framework's own package (self-hosting — the CLI reporter prints from
+``main``, which stays exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePosixPath
+from typing import List
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import entry_exempt_lines
+
+EXEMPT_DIRS = ("test_utils", "commands")
+
+
+class BarePrintRule(Rule):
+    id = "bare-print"
+    summary = "no bare print() in library code — use get_logger or PartialState.print"
+
+    def applies_to(self, rel: str) -> bool:
+        parts = PurePosixPath(rel).parts
+        if parts[-1] == "__main__.py":
+            return False
+        if parts[0] == "accelerate_tpu":
+            return len(parts) < 2 or parts[1] not in EXEMPT_DIRS
+        return parts[:2] == ("tools", "atpu_lint")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        exempt = entry_exempt_lines(tree)
+        out = []
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and node.lineno not in exempt
+            ):
+                out.append(Diagnostic(
+                    ctx.rel, node.lineno, self.id,
+                    "bare print() in library code — use get_logger(__name__) "
+                    "or PartialState.print",
+                ))
+        return out

@@ -1,0 +1,61 @@
+"""Rule ``blocking-readback``: no blocking device->host readback in the
+serving hot path.
+
+The pipelined serve loop (``ServingEngine(async_depth=1)``) works because
+dispatching window N+1 never waits on window N — every device->host
+materialization is funneled through ``serving/readback.py``'s ``fetch``,
+drained at the one point the engine has decided to block.  A stray
+``jax.device_get`` (or ``.block_until_ready()``) anywhere else in
+``accelerate_tpu/serving/`` silently re-serializes the pipeline: the loop
+still produces identical tokens, just without the overlap, which is exactly
+the kind of regression that survives every correctness test.
+
+Exempt: ``serving/readback.py`` (the one sanctioned blocking transfer lives
+there) and lines carrying ``# noqa: blocking-readback`` (legacy bare
+``# noqa: readback`` is honored with a migration warning).
+
+Ported from ``tools/check_no_blocking_readback.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import tail_name
+
+BLOCKING_NAMES = ("device_get", "block_until_ready")
+
+
+class BlockingReadbackRule(Rule):
+    id = "blocking-readback"
+    summary = "no jax.device_get / block_until_ready outside serving/readback.py"
+
+    def applies_to(self, rel: str) -> bool:
+        return (
+            rel.startswith("accelerate_tpu/serving/")
+            and not rel.endswith("/readback.py")
+        )
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        out = {}
+        for node in ast.walk(tree):
+            # flag the attribute access itself, not just calls: passing
+            # ``arr.block_until_ready`` around blocks just as hard when invoked
+            if isinstance(node, ast.Call):
+                name = tail_name(node.func)
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            else:
+                continue
+            if name not in BLOCKING_NAMES:
+                continue
+            # one diagnostic per line: a Call and its Attribute func both match
+            out[node.lineno] = Diagnostic(
+                ctx.rel, node.lineno, self.id,
+                f"blocking readback ({name}) in the serving hot path — route "
+                "it through serving/readback.fetch (or justify with "
+                "'# noqa: blocking-readback')",
+            )
+        return [out[k] for k in sorted(out)]

@@ -1,0 +1,205 @@
+"""Rule ``implicit-host-sync``: device values from jitted pool executables
+must reach the host through ``serving/readback.fetch`` — never through an
+implicit conversion.
+
+``blocking-readback`` catches the *explicit* syncs (``jax.device_get``,
+``block_until_ready``).  This rule catches the quiet ones: ``int(toks[0])``,
+``float(x)``, ``bool(x)``, ``x.item()`` / ``x.tolist()``, ``np.asarray(x)``,
+iterating a device array, or truth-testing one (``if pending:``) all force a
+blocking device->host materialization.  Inside the pipelined serve loop any
+such conversion on a window's outputs stalls the host mid-overlap: tokens
+stay identical, the speedup silently disappears — the regression class no
+correctness test can see.
+
+Dataflow is a linear per-function taint pass: values returned by calls
+through the module's visible executable bindings (``jax.jit`` / ``pjit`` /
+``_serve_jit`` results, ``RecompileWatchdog``-wrapped ``make_*`` factories,
+and ``self._put`` / ``device_put`` uploads) are device-tainted; taint flows
+through assignment, subscripts, arithmetic, and method calls, and is cleared
+by ``fetch(...)`` (the one sanctioned sync) or by rebinding from a host
+expression.  Scope: ``accelerate_tpu/serving/`` except ``readback.py``.
+Escape: ``# noqa: implicit-host-sync`` with a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import (
+    build_executable_index,
+    build_jit_index,
+    callee_executable_name,
+    dotted,
+    iter_functions,
+    linearize,
+    tail_name,
+)
+
+UPLOAD_TAILS = {"_put", "device_put"}
+SCALAR_BUILTINS = {"int", "float", "bool"}
+ITEM_METHODS = {"item", "tolist"}
+NUMPY_BASES = {"np", "numpy", "onp"}
+NUMPY_SINKS = {"asarray", "array"}
+
+
+class _Taint:
+    """Per-function device-taint state over dotted names."""
+
+    def __init__(self, executables: Set[str]):
+        self.names: Set[str] = set()
+        self.executables = executables
+
+    def expr_tainted(self, expr: Optional[ast.expr]) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            name = dotted(expr)
+            if name and name in self.names:
+                return True
+            if isinstance(expr, ast.Attribute):
+                return self.expr_tainted(expr.value)
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self.expr_tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            if tail_name(expr.func) == "fetch":
+                return False  # the sanctioned sync: result is host-side
+            if callee_executable_name(expr) in self.executables:
+                return True
+            if tail_name(expr.func) in UPLOAD_TAILS:
+                return True
+            if isinstance(expr.func, ast.Attribute) and self.expr_tainted(expr.func.value):
+                return True  # method on a device value stays on device
+            return any(self.expr_tainted(a) for a in expr.args) or any(
+                self.expr_tainted(k.value) for k in expr.keywords
+            )
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_tainted(expr.left) or self.expr_tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_tainted(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            return self.expr_tainted(expr.left) or any(
+                self.expr_tainted(c) for c in expr.comparators
+            )
+        if isinstance(expr, ast.IfExp):
+            return self.expr_tainted(expr.body) or self.expr_tainted(expr.orelse)
+        if isinstance(expr, ast.Starred):
+            return self.expr_tainted(expr.value)
+        return False
+
+    def assign(self, stmt: ast.stmt) -> None:
+        """Propagate through an assignment: targets become tainted iff the
+        value side is, elementwise when both sides are same-length tuples."""
+        if isinstance(stmt, ast.Assign):
+            value, targets_list = stmt.value, stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value, targets_list = stmt.value, [stmt.target]
+        elif isinstance(stmt, ast.AugAssign):
+            name = dotted(stmt.target)
+            if name and self.expr_tainted(stmt.value):
+                self.names.add(name)
+            return
+        else:
+            return
+        for target in targets_list:
+            if (
+                isinstance(target, (ast.Tuple, ast.List))
+                and isinstance(value, (ast.Tuple, ast.List))
+                and len(target.elts) == len(value.elts)
+            ):
+                for t, v in zip(target.elts, value.elts):
+                    self._bind(t, self.expr_tainted(v))
+            else:
+                self._bind(target, self.expr_tainted(value))
+
+    def _bind(self, target: ast.expr, tainted: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, tainted)
+            return
+        if isinstance(target, ast.Starred):
+            target = target.value
+        name = dotted(target)
+        if not name:
+            return
+        if tainted:
+            self.names.add(name)
+        else:
+            self.names.discard(name)
+
+
+class ImplicitHostSyncRule(Rule):
+    id = "implicit-host-sync"
+    summary = "no int()/float()/bool()/.item()/np.asarray/iteration/truth-test on device values"
+
+    def applies_to(self, rel: str) -> bool:
+        return (
+            rel.startswith("accelerate_tpu/serving/")
+            and not rel.endswith("/readback.py")
+        )
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        jit_index = build_jit_index(tree)
+        executables = build_executable_index(tree) | set(jit_index)
+        out: List[Diagnostic] = []
+        for fn in iter_functions(tree):
+            out.extend(self._check_function(fn, executables, ctx))
+        return out
+
+    def _check_function(self, fn, executables: Set[str], ctx) -> List[Diagnostic]:
+        taint = _Taint(executables)
+        out: List[Diagnostic] = []
+        seen: Set[tuple] = set()
+
+        def flag(node: ast.AST, what: str) -> None:
+            key = (node.lineno, what)
+            if key in seen:
+                return
+            seen.add(key)
+            out.append(Diagnostic(
+                ctx.rel, node.lineno, self.id,
+                f"implicit host sync: {what} blocks until the device value "
+                "materializes, stalling the pipelined serve loop — drain it "
+                "through serving/readback.fetch at the engine's chosen sync "
+                "point (or justify with '# noqa: implicit-host-sync')",
+            ))
+
+        for ls in linearize(fn):
+            node = ls.node
+            # sinks first, against the taint state before this statement
+            for call in ls.calls:
+                func = call.func
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in SCALAR_BUILTINS
+                    and any(taint.expr_tainted(a) for a in call.args)
+                ):
+                    flag(call, f"{func.id}() on a device value")
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in ITEM_METHODS
+                    and taint.expr_tainted(func.value)
+                ):
+                    flag(call, f".{func.attr}() on a device value")
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in NUMPY_SINKS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in NUMPY_BASES
+                    and any(taint.expr_tainted(a) for a in call.args)
+                ):
+                    flag(call, f"{func.value.id}.{func.attr}() on a device value")
+            if isinstance(node, ast.For) and taint.expr_tainted(node.iter):
+                flag(node, "iterating a device value")
+            elif isinstance(node, (ast.If, ast.While)) and taint.expr_tainted(node.test):
+                flag(node, "truth-testing a device value")
+            elif isinstance(node, ast.Assert) and taint.expr_tainted(node.test):
+                flag(node, "asserting on a device value")
+            taint.assign(node)
+        return out

@@ -1,0 +1,190 @@
+"""Rule ``jit-signature-drift``: no call-varying shape scalar may flow into a
+jitted callee as a traced-shape-affecting positional.
+
+The recompile watchdog catches signature drift at runtime — after the fleet
+has already burned minutes of compile time.  This is its static counterpart:
+a Python scalar derived from ``len(...)`` / ``.shape`` / ``range(...)`` (a
+value that varies call to call) must not reach a jitted executable in a
+position that changes traced shapes, because every new value then traces and
+compiles a fresh program:
+
+* a slice bound on an argument — ``jitted(x[:n])`` ships a different shape
+  every call (the repo's answer is bucketed executables:
+  ``self._prefill[bucket]`` keys a *dict of executables* on the padded size,
+  which this rule deliberately does not flag);
+* a shape constructor in an argument — ``jitted(jnp.zeros(n))`` /
+  ``np.full(n, ...)``;
+* a ``static_argnums`` / ``static_argnames`` position of a callee whose jit
+  declaration is visible in this module — static args are hashed into the
+  executable key, so a drifting value IS a recompile;
+* a bare drifting scalar passed positionally — harmless only if the callee
+  never lets it touch a shape; flagged so the author either wraps it
+  (``jnp.int32(n)`` arrives as a traced 0-d array) or buckets it.
+
+Linear per-function taint, no branch sensitivity; executables recognized
+from visible module bindings exactly as in ``use-after-donate``.  Scope:
+``accelerate_tpu/serving/``.  Escape: ``# noqa: jit-signature-drift`` with a
+justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import (
+    build_executable_index,
+    build_jit_index,
+    callee_executable_name,
+    dotted,
+    iter_functions,
+    linearize,
+    tail_name,
+)
+
+SHAPE_ATTRS = {"shape", "ndim", "size"}
+SHAPE_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange", "reshape",
+                      "broadcast_to", "tile", "repeat"}
+
+
+class _Drift:
+    """Tracks names holding call-varying shape scalars."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def expr_drifts(self, expr: Optional[ast.expr]) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.names
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in SHAPE_ATTRS:
+                return True
+            name = dotted(expr)
+            return bool(name and name in self.names)
+        if isinstance(expr, ast.Subscript):
+            return self.expr_drifts(expr.value)
+        if isinstance(expr, ast.Call):
+            if tail_name(expr.func) == "len":
+                return True
+            if tail_name(expr.func) == "int":
+                return any(self.expr_drifts(a) for a in expr.args)
+            return False
+        if isinstance(expr, ast.BinOp):
+            return self.expr_drifts(expr.left) or self.expr_drifts(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_drifts(expr.operand)
+        return False
+
+    def assign(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            drifts = self.expr_drifts(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, drifts)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self.expr_drifts(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            name = dotted(stmt.target)
+            if name and self.expr_drifts(stmt.value):
+                self.names.add(name)
+        elif isinstance(stmt, ast.For):
+            # a loop variable over range(...) varies per iteration
+            if (
+                isinstance(stmt.iter, ast.Call)
+                and tail_name(stmt.iter.func) == "range"
+            ):
+                self._bind(stmt.target, True)
+
+    def _bind(self, target: ast.expr, drifts: bool) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, drifts)
+            return
+        name = dotted(target)
+        if not name:
+            return
+        if drifts:
+            self.names.add(name)
+        else:
+            self.names.discard(name)
+
+
+class JitSignatureDriftRule(Rule):
+    id = "jit-signature-drift"
+    summary = "no call-varying len()/.shape scalar in a traced-shape-affecting jit positional"
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("accelerate_tpu/serving/")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        jit_index = build_jit_index(tree)
+        executables = build_executable_index(tree) | set(jit_index)
+        out: List[Diagnostic] = []
+        for fn in iter_functions(tree):
+            out.extend(self._check_function(fn, jit_index, executables, ctx))
+        return out
+
+    def _check_function(self, fn, jit_index, executables: Set[str], ctx) -> List[Diagnostic]:
+        drift = _Drift()
+        out: List[Diagnostic] = []
+        seen: Set[tuple] = set()
+
+        def flag(node: ast.AST, what: str) -> None:
+            key = (node.lineno, what)
+            if key in seen:
+                return
+            seen.add(key)
+            out.append(Diagnostic(
+                ctx.rel, node.lineno, self.id,
+                f"jit signature drift: {what} — every new value traces and "
+                "compiles a fresh executable; bucket the size (dict of "
+                "executables keyed on the padded shape) or wrap the scalar "
+                "as a device array (jnp.int32(n)) so it arrives traced",
+            ))
+
+        for ls in linearize(fn):
+            for call in ls.calls:
+                callee = callee_executable_name(call)
+                if callee not in executables:
+                    continue
+                target = jit_index.get(dotted(call.func) or "")
+                for pos, arg in enumerate(call.args):
+                    self._check_arg(arg, pos, target, drift, flag)
+                for kw in call.keywords:
+                    if (
+                        target is not None
+                        and kw.arg in target.static_names
+                        and drift.expr_drifts(kw.value)
+                    ):
+                        flag(kw.value, f"drifting scalar bound to static_argname "
+                                       f"'{kw.arg}' of {target.name}()")
+            drift.assign(ls.node)
+        return out
+
+    def _check_arg(self, arg: ast.expr, pos: int, target, drift: _Drift, flag) -> None:
+        # slice with a drifting bound: the argument's shape varies per call
+        if isinstance(arg, ast.Subscript):
+            slices = arg.slice.elts if isinstance(arg.slice, ast.Tuple) else [arg.slice]
+            for s in slices:
+                if isinstance(s, ast.Slice) and any(
+                    drift.expr_drifts(b) for b in (s.lower, s.upper, s.step)
+                ):
+                    flag(arg, "argument sliced by a call-varying bound "
+                              "(varying traced shape)")
+                    return
+        # shape constructor sized by a drifting scalar
+        if isinstance(arg, ast.Call) and tail_name(arg.func) in SHAPE_CONSTRUCTORS:
+            if any(drift.expr_drifts(a) for a in arg.args):
+                flag(arg, f"{tail_name(arg.func)}(...) sized by a call-varying "
+                          "scalar (varying traced shape)")
+                return
+        # bare drifting scalar in a positional slot (x.shape[0], len(x), n)
+        if drift.expr_drifts(arg):
+            if target is not None and pos in target.static_positions:
+                flag(arg, f"drifting scalar at static_argnums position {pos} "
+                          f"of {target.name}()")
+            else:
+                flag(arg, "call-varying shape scalar passed positionally to a "
+                          "jitted callee")

@@ -1,0 +1,83 @@
+"""Rule ``method-lru-cache``: no ``functools.lru_cache`` / ``functools.cache``
+on instance methods.
+
+An lru_cache on a method keys its cache on ``self``: every instance gets its
+own entry, the cache keeps each instance alive for the lifetime of the class
+(a memory leak), and per-instance state silently defeats the dedupe the cache
+was meant to provide — exactly the bug class fixed in
+``MultiProcessAdapter.warning_once`` (see ``accelerate_tpu/logging.py``).
+Module-level functions are fine; methods must use an explicit container keyed
+on what they actually mean to dedupe (a module-level set/dict, or
+``functools.cached_property`` for a compute-once attribute).
+
+Exempt: ``accelerate_tpu/test_utils/`` and ``accelerate_tpu/commands/``
+(short-lived CLI/test objects can't leak long), ``@staticmethod`` methods
+(no ``self``/``cls`` in the key), and ``# noqa: method-lru-cache`` lines.
+
+Ported from ``tools/check_no_method_lru_cache.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePosixPath
+from typing import List
+
+from ..core import Diagnostic, Rule
+
+EXEMPT_DIRS = ("test_utils", "commands")
+BANNED = ("lru_cache", "cache")
+
+
+def _deco_name(deco: ast.expr) -> str:
+    target = deco.func if isinstance(deco, ast.Call) else deco
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+        return f"{target.value.id}.{target.attr}"
+    return ""
+
+
+def _is_banned(deco: ast.expr) -> bool:
+    name = _deco_name(deco)
+    return name in BANNED or name in tuple(f"functools.{b}" for b in BANNED)
+
+
+class MethodLruCacheRule(Rule):
+    id = "method-lru-cache"
+    summary = "no functools.lru_cache/cache on instance methods (keys on self, leaks)"
+
+    def applies_to(self, rel: str) -> bool:
+        parts = PurePosixPath(rel).parts
+        if parts[-1] == "__main__.py":
+            return False
+        if parts[0] == "accelerate_tpu":
+            return len(parts) < 2 or parts[1] not in EXEMPT_DIRS
+        return parts[:2] == ("tools", "atpu_lint")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        out = []
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                deco_names = [_deco_name(d) for d in fn.decorator_list]
+                if "staticmethod" in deco_names:
+                    continue
+                args = fn.args.posonlyargs + fn.args.args
+                if not args or args[0].arg not in ("self", "cls"):
+                    continue
+                for deco in fn.decorator_list:
+                    if not _is_banned(deco):
+                        continue
+                    out.append(Diagnostic(
+                        ctx.rel, deco.lineno, self.id,
+                        f"functools.{_deco_name(deco).split('.')[-1]} on method "
+                        f"{cls.name}.{fn.name} — the cache keys on "
+                        f"{args[0].arg!r}, leaking every instance and deduping "
+                        "per-instance; use a module-level container or "
+                        "cached_property",
+                    ))
+        return out

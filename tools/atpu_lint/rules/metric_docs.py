@@ -1,0 +1,138 @@
+"""Rule ``metric-docs``: the observability doc and the metric registry agree
+in BOTH directions.
+
+Forward (ported from ``tools/check_metric_docs.py``): any literal metric name
+passed to ``registry.counter(...)``, ``registry.gauge(...)`` or
+``registry.histogram(...)`` inside ``accelerate_tpu/`` must appear verbatim
+in ``docs/usage/observability.md`` — the doc is the operator-facing contract
+for what a ``/metrics`` scrape can contain, and an undocumented gauge is
+invisible to whoever has to build the dashboard.
+
+Reverse (new with the port — the old script was asymmetric): every concrete
+metric name in the doc's metric table must still be emitted somewhere, or the
+row is an *orphan* that sends the dashboard builder hunting for a series that
+no longer exists.  A doc name counts as emitted when it matches a literal
+registration OR a dynamic f-string family (``f"serve/{k}_total"`` matches
+``serve/preemptions_total``).  Doc names carrying ``*`` or ``<`` are
+documented patterns and skipped; so are names outside the table's metrics
+column (the spans column names tracer spans, not registry series).
+
+Only string-literal (or f-string) first arguments are checked; names built
+from opaque variables are skipped.  ``# noqa: metric-docs`` on the
+registration line exempts it.
+
+The orphan direction runs only when the whole ``accelerate_tpu`` package is
+on the lint surface: on a partial run (``python -m tools.atpu_lint
+accelerate_tpu/serving``) the absence of a registration proves nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Tuple
+
+from ..core import Diagnostic, Rule
+
+FACTORIES = ("counter", "gauge", "histogram")
+_CONCRETE = re.compile(r"[a-z0-9_]+(?:/[a-z0-9_]+)+")
+
+
+class MetricDocsRule(Rule):
+    id = "metric-docs"
+    summary = "every emitted metric is documented; every documented metric is emitted"
+
+    def __init__(self):
+        self._literals: List[Tuple[str, int, str, str]] = []  # rel, line, kind, name
+        self._patterns: List[re.Pattern] = []
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("accelerate_tpu/")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in FACTORIES
+                and node.args
+            ):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                self._literals.append((ctx.rel, node.lineno, node.func.attr, first.value))
+            elif isinstance(first, ast.JoinedStr):
+                parts = []
+                for piece in first.values:
+                    if isinstance(piece, ast.Constant):
+                        parts.append(re.escape(str(piece.value)))
+                    else:
+                        parts.append(r".+")
+                self._patterns.append(re.compile("".join(parts)))
+        return []
+
+    def finalize(self, project) -> List[Diagnostic]:
+        doc_rel = project.observability_doc
+        doc_path = project.root / doc_rel
+        if not doc_path.exists():
+            if not self._literals:
+                return []
+            return [Diagnostic(doc_rel, 1, self.id, f"missing {doc_rel}")]
+        doc_text = doc_path.read_text()
+        out: List[Diagnostic] = []
+        for rel, lineno, kind, name in self._literals:
+            if name not in doc_text:
+                out.append(Diagnostic(
+                    rel, lineno, self.id,
+                    f"{kind} '{name}' is not documented in {doc_rel}",
+                ))
+        if not self._covers_package(project):
+            return out
+        emitted = {name for _, _, _, name in self._literals}
+        for lineno, name in self._doc_table_names(doc_text):
+            if name in emitted or any(p.fullmatch(name) for p in self._patterns):
+                continue
+            out.append(Diagnostic(
+                doc_rel, lineno, self.id,
+                f"orphan doc row: metric '{name}' is documented but no longer "
+                "emitted by any registry.counter/gauge/histogram call",
+                src_line=name,
+            ))
+        return out
+
+    @staticmethod
+    def _covers_package(project) -> bool:
+        """True when every lintable file of ``accelerate_tpu/`` was visited
+        this run — the precondition for "nothing emits this name" to mean
+        anything.  Fixture projects without the package count as covered."""
+        pkg = project.root / "accelerate_tpu"
+        if not pkg.is_dir():
+            return True
+        visited = {ctx.rel for ctx in project.files}
+        for f in pkg.rglob("*.py"):
+            rel = project.rel(f)
+            if "__pycache__" in rel.split("/"):
+                continue
+            if rel not in visited:
+                return False
+        return True
+
+    @staticmethod
+    def _doc_table_names(doc_text: str) -> List[Tuple[int, str]]:
+        """Concrete metric names in the metrics column (cell 2) of markdown
+        table rows.  Backticked tokens with ``*``/``<`` are documented
+        dynamic families, not concrete names."""
+        found = []
+        for i, line in enumerate(doc_text.splitlines(), start=1):
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = line.split("|")
+            if len(cells) < 4:
+                continue
+            for m in re.finditer(r"`([^`]+)`", cells[2]):
+                token = m.group(1)
+                if "*" in token or "<" in token:
+                    continue
+                if _CONCRETE.fullmatch(token):
+                    found.append((i, token))
+        return found

@@ -1,0 +1,49 @@
+"""Rule ``pallas-interpret``: every ``pl.pallas_call`` must thread an
+``interpret=`` kwarg.
+
+Pallas kernels only run compiled on a real TPU; everywhere else (CPU CI, dev
+laptops, the CPU half of a TPU pod host) they need ``interpret=True`` to run
+at all.  The repo's convention is that every kernel entry point accepts an
+``interpret`` argument defaulting to ``_default_interpret()`` (off-TPU
+autodetection — see ``accelerate_tpu/ops/flash_attention.py``) and threads it
+into the ``pallas_call``.  A ``pallas_call`` with no ``interpret=`` kwarg
+hard-codes TPU-only behavior and breaks the CPU A/B oracles the test suite is
+built on, so it is a lint error even when the kernel "is only meant for TPU".
+
+A ``**kwargs`` splat at the call site counts as threading (the kwarg may
+arrive dynamically); ``# noqa: pallas-interpret`` lines are exempt.
+
+Ported from ``tools/check_pallas_interpret.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import tail_name
+
+
+class PallasInterpretRule(Rule):
+    id = "pallas-interpret"
+    summary = "every pallas_call threads interpret= so kernels run off-TPU"
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("accelerate_tpu/")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or tail_name(node.func) != "pallas_call":
+                continue
+            names = {kw.arg for kw in node.keywords}  # None marks a **splat
+            if "interpret" in names or None in names:
+                continue
+            out.append(Diagnostic(
+                ctx.rel, node.lineno, self.id,
+                "pallas_call without interpret= — thread the caller's "
+                "interpret flag (default _default_interpret()) so the kernel "
+                "runs off-TPU",
+            ))
+        return out

@@ -1,0 +1,55 @@
+"""Rule ``sharding-annotations``: every jit in the serving package threads
+explicit shardings.
+
+Serving executables are compiled once and reused across thousands of steps;
+a ``jax.jit``/``pjit`` without ``in_shardings``/``out_shardings`` leaves
+placement to GSPMD's propagation pass, which is free to pick a layout that
+silently diverges from the head-sharded KV pool (a resharding collective in
+the decode loop, or worse, a replicated pool that quietly undoes the tp
+memory win).  So inside ``accelerate_tpu/serving/`` every ``jax.jit`` /
+``jax.pjit`` / bare ``jit(...)`` call must pass at least one of the
+``in_shardings`` / ``out_shardings`` keywords — in practice by going through
+``pool._serve_jit``, which threads both or documents why not.
+
+An intentionally unconstrained call carries ``# noqa: sharding-annotations``
+with a reason (the legacy bare ``# noqa: sharding`` is honored with a
+migration warning).  Decorator usage (``@jax.jit``) is a call node too and
+is checked the same way.
+
+Ported from ``tools/check_sharding_annotations.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import tail_name
+
+JIT_NAMES = ("jit", "pjit")
+SHARDING_KWARGS = ("in_shardings", "out_shardings")
+
+
+class ShardingAnnotationsRule(Rule):
+    id = "sharding-annotations"
+    summary = "every jit in serving/ passes in_shardings/out_shardings"
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("accelerate_tpu/serving/")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        out = []
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and tail_name(node.func) in JIT_NAMES
+                and not any(kw.arg in SHARDING_KWARGS for kw in node.keywords)
+            ):
+                out.append(Diagnostic(
+                    ctx.rel, node.lineno, self.id,
+                    "jit without in_shardings/out_shardings — route it "
+                    "through pool._serve_jit or add "
+                    "'# noqa: sharding-annotations' with a reason",
+                ))
+        return out

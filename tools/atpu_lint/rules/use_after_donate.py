@@ -1,0 +1,200 @@
+"""Rule ``use-after-donate``: donated device buffers are dead on dispatch —
+never read one afterwards, and never drop the old handle mid-flight.
+
+Two findings, both from the bug class the async pipelined serve loop (PR 9)
+hit:
+
+* **read-after-donate** — a name passed at a ``donate_argnums`` /
+  ``donate_argnames`` position of a jit call visibly donating in this module
+  is read again in the same function before being rebound.  The buffer was
+  aliased into the computation's outputs; the read sees freed memory (jax
+  raises on CPU, silently corrupts on deferred paths).
+
+* **dropped-handle** — the donate-and-rebind idiom
+  (``kv.pages, toks = self._decode(params, kv.pages, ...)``) rebinds a device
+  handle that the just-dispatched window consumes, WITHOUT parking the old
+  handle first.  Dropping the last Python reference to a consumed handle
+  blocks until the consuming computation retires — the engine re-serializes
+  and every overlap the pipeline exists for silently disappears, with tokens
+  staying bit-identical (the exact regression ``serving/readback.py``'s
+  ``Readback.consumed`` parking fixes).  The rebind is clean when the old
+  handles were parked into a surviving binding beforehand (``consumed =
+  [kv.pages_k, ...]``) or when the function drains synchronously (a
+  ``fetch(...)`` / ``_drain_inflight(...)`` call after the dispatch, so no
+  window escapes in flight).
+
+Detection is linear per function (no branch sensitivity) and recognizes
+executables by the module's visible bindings (``jax.jit``/``pjit``/
+``_serve_jit`` results, ``RecompileWatchdog``-wrapped pool ``make_*``
+factories, per-bucket dicts thereof); ``*args`` splats are expanded through
+same-function tuple literals.  Scope: ``accelerate_tpu/serving/``.  Escape:
+``# noqa: use-after-donate`` with a justifying comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import (
+    LinearStmt,
+    build_executable_index,
+    build_jit_index,
+    call_arg_names,
+    callee_executable_name,
+    dotted,
+    iter_functions,
+    linearize,
+    tail_name,
+    tuple_literal_map,
+)
+
+DRAIN_MARKERS = {"fetch", "_drain_inflight"}
+
+
+def _targets_of(stmt: ast.stmt) -> List[str]:
+    """Flattened dotted assignment-target names of an Assign statement."""
+    if not isinstance(stmt, ast.Assign):
+        return []
+    out: List[str] = []
+
+    def flatten(node: ast.expr) -> None:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                flatten(elt)
+        elif isinstance(node, ast.Starred):
+            flatten(node.value)
+        else:
+            name = dotted(node)
+            if name:
+                out.append(name)
+
+    for target in stmt.targets:
+        flatten(target)
+    return out
+
+
+def _top_call(stmt: ast.stmt) -> Optional[ast.Call]:
+    value = getattr(stmt, "value", None)
+    return value if isinstance(value, ast.Call) else None
+
+
+def _is_parking_stmt(ls: LinearStmt, name: str) -> bool:
+    """Does this statement park ``name`` into a surviving binding?  An Assign
+    or AugAssign whose value side loads the name (``consumed = [x, ...]``,
+    ``consumed += [x]``), or a ``something.append(x)`` / ``.extend([... x])``
+    call.  A bare call argument (``audit_donation(x)``) does NOT park — the
+    reference dies with the call."""
+    node = ls.node
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        value = node.value
+        if value is not None:
+            for sub in ast.walk(value):
+                if isinstance(sub, (ast.Name, ast.Attribute)) and dotted(sub) == name:
+                    return True
+        return False
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        call = node.value
+        if tail_name(call.func) in ("append", "extend"):
+            for arg in call.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, (ast.Name, ast.Attribute)) and dotted(sub) == name:
+                        return True
+    return False
+
+
+def _has_drain_after(stmts: Sequence[LinearStmt], idx: int) -> bool:
+    for ls in stmts[idx + 1:]:
+        for call in ls.calls:
+            if tail_name(call.func) in DRAIN_MARKERS:
+                return True
+    return False
+
+
+class UseAfterDonateRule(Rule):
+    id = "use-after-donate"
+    summary = "no read of a donated buffer; donate-and-rebind must park old handles"
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("accelerate_tpu/serving/")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        jit_index = build_jit_index(tree)
+        executables = build_executable_index(tree) | set(jit_index)
+        out: List[Diagnostic] = []
+        for fn in iter_functions(tree):
+            out.extend(self._check_function(fn, jit_index, executables, ctx))
+        return out
+
+    def _check_function(self, fn, jit_index, executables: Set[str], ctx) -> List[Diagnostic]:
+        stmts = linearize(fn)
+        tuple_map = tuple_literal_map(stmts)
+        out: List[Diagnostic] = []
+        reported: Set[tuple] = set()
+        for idx, ls in enumerate(stmts):
+            call = _top_call(ls.node)
+            if call is None:
+                continue
+            callee = callee_executable_name(call)
+            targets = _targets_of(ls.node)
+            arg_names = call_arg_names(call, tuple_map)
+            arg_set = {a for a in arg_names if a}
+
+            # --- read-after-donate: resolvable donate positions ------------
+            target = jit_index.get(dotted(call.func) or "")
+            if target is not None and target.donates:
+                donated = [
+                    arg_names[i]
+                    for i in target.donate_positions
+                    if i < len(arg_names) and arg_names[i]
+                ]
+                donated += [
+                    dotted(kw.value)
+                    for kw in call.keywords
+                    if kw.arg in target.donate_names and dotted(kw.value)
+                ]
+                for name in donated:
+                    if name in targets:
+                        continue  # rebound by this very statement
+                    for later in stmts[idx + 1:]:
+                        if name in later.loads and (later.lineno, name) not in reported:
+                            reported.add((later.lineno, name))
+                            out.append(Diagnostic(
+                                ctx.rel, later.lineno, self.id,
+                                f"'{name}' was donated to {target.name}() on "
+                                f"line {ls.lineno} and is read here — the "
+                                "buffer is dead after dispatch; use the "
+                                "returned handle instead",
+                            ))
+                        if name in later.stores:
+                            break
+
+            # --- dropped-handle: donate-and-rebind without parking ---------
+            if callee not in executables:
+                continue
+            rebound = sorted(arg_set & set(targets))
+            if not rebound:
+                continue
+            if _has_drain_after(stmts, idx):
+                continue  # synchronous drain: no window escapes in flight
+            unparked = [
+                name for name in rebound
+                if not any(
+                    _is_parking_stmt(prev, name) and prev.node is not ls.node
+                    for prev in stmts[:idx]
+                )
+            ]
+            if unparked and (ls.lineno, "rebind") not in reported:
+                reported.add((ls.lineno, "rebind"))
+                out.append(Diagnostic(
+                    ctx.rel, ls.lineno, self.id,
+                    f"donate-and-rebind of {', '.join(unparked)} through "
+                    f"{callee}(...) drops the old device handle(s) while the "
+                    "dispatched window may still consume them — dropping the "
+                    "last reference blocks until the window retires and "
+                    "silently re-serializes the pipeline; park the old "
+                    "handles (e.g. on Readback.consumed) before dispatch, or "
+                    "drain with fetch() in this function",
+                ))
+        return out
