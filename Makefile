@@ -53,6 +53,7 @@ bench-serve:
 	python bench_inference.py --task serve --kernel-ab
 	python bench_inference.py --task serve --tp-ab
 	python bench_inference.py --task serve --async-ab
+	python bench_inference.py --task serve --http-ab
 	python bench_inference.py --task spec
 
 # one process, one AST load per file, all ten rules (tools/atpu_lint/rules/);

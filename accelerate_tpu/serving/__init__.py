@@ -6,6 +6,7 @@ pool becomes a refcounted page pool behind per-lane block tables
 """
 
 from .engine import ServingEngine
+from .errors import AdmissionError
 from .paging import NULL_PAGE, PageAllocator, PagedKVPool
 from .pool import (
     ServeShardings,
@@ -28,6 +29,7 @@ from .spec import propose_ngram_draft
 
 __all__ = [
     "ServingEngine",
+    "AdmissionError",
     "ReplicaRouter",
     "ServeShardings",
     "Request",

@@ -58,6 +58,7 @@ from ..telemetry import (
     get_tracer,
     start_debug_server,
 )
+from .errors import AdmissionError
 from .paging import PagedKVPool
 from .pool import (
     ServeShardings,
@@ -206,6 +207,18 @@ class ServingEngine:
         ``async_depth=0`` when callbacks must observe tokens the same step
         the device produced them, or to bisect a suspected pipelining bug.
         See ``docs/usage/serving.md`` ("Async pipelined serving").
+    max_queue: admission backpressure bound — a ``submit`` that would push
+        the waiting queue past this raises a *retriable*
+        :class:`~accelerate_tpu.serving.errors.AdmissionError` (queue depth
+        + retry-after hint attached) instead of queueing unboundedly.  The
+        HTTP front door maps it to 429; the
+        :class:`~accelerate_tpu.serving.router.ReplicaRouter` failover
+        ladder tries the next replica.  ``None`` (default) keeps the queue
+        unbounded.  Preemption replay re-enters at the queue FRONT and is
+        never refused.
+    weights_version: operator-facing label for the parameter set currently
+        served — surfaced by ``/v1/models`` and rotated by
+        :meth:`swap_params` during zero-downtime weight hot-swap.
     """
 
     def __init__(
@@ -234,6 +247,8 @@ class ServingEngine:
         mesh=None,
         tp_axis: str = "tp",
         async_depth: int = 1,
+        max_queue: Optional[int] = None,
+        weights_version: str = "v0",
     ):
         cfg = model.config
         self.model = model
@@ -508,7 +523,13 @@ class ServingEngine:
             prefill_token_budget if prefill_token_budget is not None else self.buckets[-1],
             prefix_cache=self.prefix_cache,
             recorder=self.recorder,
+            max_queue=max_queue,
         )
+        #: label of the parameter set currently served; rotated by swap_params
+        self.weights_version = str(weights_version)
+        #: True while a drain / hot-swap holds new prefills back (queued
+        #: requests stay queued; in-flight lanes run to completion)
+        self.admission_paused = False
 
         n = self.num_slots
         # host-side per-slot lane state, shipped to the decode window each step
@@ -558,6 +579,7 @@ class ServingEngine:
             "preemptions": 0,
             "cow_copies": 0,
             "prefreed_lanes": 0,
+            "hot_swaps": 0,
         }
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
@@ -698,18 +720,22 @@ class ServingEngine:
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if prompt.size > self.max_prompt_len:
-            raise ValueError(
-                f"prompt length {prompt.size} > max_prompt_len {self.max_prompt_len}"
+            raise AdmissionError(
+                f"prompt length {prompt.size} > max_prompt_len {self.max_prompt_len}",
+                queue_depth=self.scheduler.queue_depth,
+                retriable=False,
             )
         # headroom for the widest device pass this engine can run: a verify
         # cycle writes speculate_k + 1 KV positions in one forward
         span = max(self.window, self.speculate_k + 1)
         need = prompt.size + gen.max_new_tokens + span
         if need > self.max_len:
-            raise ValueError(
+            raise AdmissionError(
                 f"prompt {prompt.size} + max_new_tokens {gen.max_new_tokens} + "
                 f"max(decode_window, speculate_k + 1) {span} = {need} exceeds "
-                f"slot capacity {self.max_len}"
+                f"slot capacity {self.max_len}",
+                queue_depth=self.scheduler.queue_depth,
+                retriable=False,
             )
         # the chunk plan pads the final chunk up to its bucket; that padding
         # must still fit the prefill write target (the scratch cache, or the
@@ -717,9 +743,11 @@ class ServingEngine:
         padded = sum(b for b, _ in plan_chunks(prompt.size, self.buckets))
         cap = self.max_len if self.paged else self.max_prompt_len
         if padded > cap:
-            raise ValueError(
+            raise AdmissionError(
                 f"prompt {prompt.size} pads to {padded} prefill tokens under "
-                f"buckets {self.buckets}, exceeding capacity {cap}"
+                f"buckets {self.buckets}, exceeding capacity {cap}",
+                queue_depth=self.scheduler.queue_depth,
+                retriable=False,
             )
         now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
@@ -763,6 +791,86 @@ class ServingEngine:
             return True
         return False
 
+    # ------------------------------------------------------- drain / hot-swap
+    def pause_admission(self) -> None:
+        """Stop starting new prefills.  Queued requests stay queued, a
+        request already mid-prefill finishes its chunks, and active lanes
+        decode to completion — after enough ``step()`` calls the engine
+        reaches quiescence (:attr:`drained`).  The drain-replica and weight
+        hot-swap paths both start here."""
+        self.admission_paused = True
+
+    def resume_admission(self) -> None:
+        """Re-open admission; queued requests start prefilling next step."""
+        self.admission_paused = False
+
+    @property
+    def drained(self) -> bool:
+        """True when no lane is active, no prefill is mid-flight, and no
+        decode window is in the pipeline — the quiescence :meth:`swap_params`
+        requires.  Queued requests do NOT block drain: they have no device
+        state and run under whatever weights are live when admission
+        resumes."""
+        return (
+            not self._active.any()
+            and self._inflight is None
+            and self.scheduler.prefilling is None
+            and self._reserved_slot is None
+        )
+
+    def swap_params(self, params: Any, version: Optional[str] = None) -> None:
+        """Zero-downtime weight hot-swap: rebind this engine's parameters.
+
+        Requires quiescence (:attr:`drained` — pause admission and ``step()``
+        until lanes finish); raises ``RuntimeError`` otherwise rather than
+        splice weights mid-request.  The new params ride the same upload path
+        as ``__init__`` (tp-sharded under a mesh via ``SERVING_TP_RULES``),
+        so every compiled executable — prefill buckets, decode windows, copy
+        chunks — is REUSED as-is: a swap costs one host-to-device transfer,
+        never a recompile.  The prefix cache is flushed first (queued pins
+        dropped): retained KV was computed under the old weights, and
+        replaying it would silently corrupt tokens.  Queued requests survive
+        and decode under the new weights.  Admission stays wherever the
+        caller put it — resume explicitly after cutover.
+        """
+        if not self.drained:
+            raise RuntimeError(
+                "swap_params requires a drained engine (pause_admission, then "
+                "step until engine.drained): active lanes or an in-flight "
+                "window would mix weight versions mid-request"
+            )
+        if self.prefix_cache is not None:
+            # queued requests hold pins from admission-time matching; drop
+            # them (they re-match against fresh KV at prefill) so flush can
+            # take every node
+            self.scheduler.drop_cache_pins()
+            flushed = self.prefix_cache.flush()
+        else:
+            flushed = 0
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_pytree_with_path
+            from ..parallel.tensor_parallel import (
+                SERVING_TP_RULES,
+                make_tp_sharding_fn,
+            )
+
+            self.params, _ = shard_pytree_with_path(
+                params,
+                make_tp_sharding_fn(
+                    self.mesh, axis_name=self.tp_axis, rules=SERVING_TP_RULES
+                ),
+            )
+        else:
+            self.params = jax.device_put(params)
+        old = self.weights_version
+        if version is not None:
+            self.weights_version = str(version)
+        self._bump("hot_swaps")
+        self.recorder.record(
+            "serve/hot_swap", old_version=old, new_version=self.weights_version,
+            step=self._step_count, cache_nodes_flushed=flushed,
+        )
+
     # -------------------------------------------------------------- admission
     def _next_free_slot(self) -> Optional[int]:
         # a lane freed while its window is still in flight is immediately
@@ -776,9 +884,16 @@ class ServingEngine:
         return None
 
     def _admit(self) -> None:
+        # paused admission (drain / hot-swap): never START a prefill, but a
+        # request already mid-prefill finishes — abandoning it would leak its
+        # reserved slot and cache pins
+        if self.admission_paused and self.scheduler.prefilling is None:
+            return
         budget = self.scheduler.begin_step()
         while True:
             if self.scheduler.prefilling is None:
+                if self.admission_paused:
+                    return
                 slot = self._next_free_slot()
                 if slot is None or not self.scheduler.queue:
                     return
@@ -1015,10 +1130,18 @@ class ServingEngine:
                 # keeps the resident node's pages and refs untouched
                 self.kv.allocator.ref(ids)
         else:
+            k = self.scratch.k[:, :, start:start + bucket]
+            v = self.scratch.v[:, :, start:start + bucket]
+            if bucket == self.scratch.k.shape[2]:
+                # a full-extent slice can alias the scratch buffer itself
+                # (XLA elides the identity slice) — the cache must own a real
+                # copy, or the next hit's copy executable sees its own donated
+                # scratch arrive again as the node argument and aborts with
+                # `f(donate(a), a)`.  Only possible when a prefill bucket
+                # equals max_prompt_len; strict sub-slices always copy.
+                k, v = jnp.copy(k), jnp.copy(v)
             node = self.prefix_cache.insert(
-                parent, ptoks[start:start + bucket],
-                self.scratch.k[:, :, start:start + bucket],
-                self.scratch.v[:, :, start:start + bucket],
+                parent, ptoks[start:start + bucket], k, v,
             )
         if node is None:
             req.cache_chain_broken = True

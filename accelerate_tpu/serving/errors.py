@@ -1,0 +1,62 @@
+"""Typed admission refusals for the serving stack.
+
+Every layer that submits into a :class:`~accelerate_tpu.serving.engine.
+ServingEngine` — the :class:`~accelerate_tpu.serving.router.ReplicaRouter`
+failover ladder, the HTTP front door (:mod:`accelerate_tpu.serving.api`),
+benches — used to match refusals on ``ValueError`` and, implicitly, on the
+message text when it needed to tell "queue full, retry" apart from "this
+prompt can never fit".  :class:`AdmissionError` makes the distinction a
+type + fields:
+
+* ``retriable=True`` — transient backpressure (queue at ``max_queue``):
+  retrying the same request later can succeed.  The API layer maps it to
+  HTTP 429 with a ``Retry-After`` derived from ``retry_after_s``.
+* ``retriable=False`` — a capacity refusal (prompt longer than this
+  engine's ``max_prompt_len`` / slot budget): retrying the same request on
+  the SAME engine can never succeed, but another replica with different
+  geometry might take it — exactly what the router's failover ladder does.
+  The API layer maps it to HTTP 400.
+
+``AdmissionError`` subclasses ``ValueError`` so pre-existing callers that
+catch the old stringly refusals keep working unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["AdmissionError"]
+
+
+class AdmissionError(ValueError):
+    """An engine refused to admit a request.
+
+    Parameters
+    ----------
+    message: human-readable refusal reason (the old ``ValueError`` text).
+    queue_depth: requests queued or mid-prefill on the refusing engine at
+        refusal time — the load signal a front door can surface.
+    retry_after_s: hint for when the same submit could succeed (``None``
+        when no estimate makes sense, e.g. capacity refusals).
+    retriable: ``True`` for transient backpressure (queue full), ``False``
+        for capacity refusals that can never succeed on this engine.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        queue_depth: int = 0,
+        retry_after_s: Optional[float] = None,
+        retriable: bool = True,
+    ):
+        super().__init__(message)
+        self.queue_depth = int(queue_depth)
+        self.retry_after_s = retry_after_s
+        self.retriable = bool(retriable)
+
+    def __repr__(self) -> str:  # refusals land in logs; make them greppable
+        return (
+            f"AdmissionError({str(self)!r}, queue_depth={self.queue_depth}, "
+            f"retry_after_s={self.retry_after_s}, retriable={self.retriable})"
+        )

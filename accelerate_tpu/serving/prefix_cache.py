@@ -250,6 +250,19 @@ class PrefixCache:
         self._remove(victim)
         return True
 
+    def flush(self) -> int:
+        """Drop every unpinned node, leaf-first (interior nodes become leaves
+        as their children go).  The weight hot-swap path calls this: retained
+        KV was computed under the OLD weights, and replaying it after a swap
+        would splice stale activations into fresh prefill — token corruption
+        no output check downstream could attribute.  Pinned nodes (``refs >
+        0``) survive; callers drop queued requests' pins first
+        (:meth:`Scheduler.drop_cache_pins`).  Returns nodes removed."""
+        removed = 0
+        while self.evict_one():
+            removed += 1
+        return removed
+
     def _make_room(self, nbytes: int) -> bool:
         """Evict LRU unpinned leaves until ``nbytes`` more fits; False if the
         survivors (pinned or interior) can't shrink far enough."""

@@ -19,10 +19,27 @@ matched token count; the best positive scorer wins, load breaking ties, and
 zero-scorers fall back to least-loaded.  Policy ``"round_robin"`` is the
 baseline A/B arm (``bench_inference.py --task serve --tp-ab``).
 
-Failover: a replica that rejects a ``submit`` (capacity validation —
-e.g. heterogeneous ``max_len``) is skipped and the request tries the
-remaining replicas by load; the error propagates only when every replica
-refuses.
+Failover: a replica that refuses a ``submit`` with an
+:class:`~accelerate_tpu.serving.errors.AdmissionError` — transient queue
+backpressure (``retriable=True``) or a capacity refusal such as a
+heterogeneous ``max_len`` (``retriable=False``) — is skipped and the request
+tries the remaining replicas by load; the LAST refusal propagates only when
+every replica refuses.  Matching is on the type, never on message text.
+
+Elasticity: replicas come and go at runtime.  :meth:`add_replica` attaches a
+freshly built engine; :meth:`drain_replica` stops routing NEW requests to a
+replica while everything it already accepted (queued included) runs to
+completion, after which :meth:`step` detaches it automatically.  Because
+detach re-indexes ``engines``, every routed request also carries a *stable*
+``replica_id``; :meth:`cancel` resolves through it first.  :meth:`hot_swap`
+composes the same machinery into a rolling zero-downtime weight swap: each
+replica in turn pauses admission, drains its lanes (the OTHER replicas keep
+serving, and its own queue merely waits), rebinds params through the
+engine's donated-upload path (:meth:`ServingEngine.swap_params` — compiled
+executables are reused, no recompile), and resumes.  Replicas may run
+different ``weights_version`` labels between swaps — ``submit(...,
+model_version=...)`` pins a request to one version, which is how two
+checkpoints A/B behind a single endpoint.
 
 Telemetry (``docs/usage/observability.md``): ``serve/replicas`` (info),
 ``serve/router_affinity_hit_rate`` (fraction of routed requests whose chosen
@@ -38,6 +55,7 @@ import numpy as np
 
 from ..telemetry import MetricsRegistry, get_flight_recorder, get_registry
 from .engine import ServingEngine
+from .errors import AdmissionError
 from .pool import plan_chunks
 from .scheduler import Request
 
@@ -71,16 +89,22 @@ class ReplicaRouter:
         if policy not in _POLICIES:
             raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
         self.engines: List[ServingEngine] = list(engines)
+        # stable per-replica identities, parallel to ``engines``: positions
+        # shift when an earlier replica detaches, ids never do
+        self._ids: List[int] = list(range(len(self.engines)))
+        self._next_id = len(self.engines)
+        self._draining: set = set()  # stable ids not admitting new requests
         self.policy = policy
         self.metrics = registry if registry is not None else get_registry()
         self.recorder = get_flight_recorder()
         self._rr_next = 0
         self._routed = 0
         self._affinity_hits = 0
-        self.metrics.gauge(
+        self._replicas_gauge = self.metrics.gauge(
             "serve/replicas",
             help="info gauge: engine replicas behind the ReplicaRouter",
-        ).set(float(len(self.engines)))
+        )
+        self._replicas_gauge.set(float(len(self.engines)))
         self._affinity_gauge = self.metrics.gauge(
             "serve/router_affinity_hit_rate",
             help="fraction of routed requests whose chosen replica already "
@@ -105,20 +129,32 @@ class ReplicaRouter:
         nodes = engine.prefix_cache.match(prompt, chunks)
         return sum(len(n.tokens) for n in nodes)
 
-    def _choose(self, prompt: np.ndarray) -> tuple:
-        """``(replica_index, affinity_score)`` under the configured policy."""
+    def _admittable(self, model_version: Optional[str] = None) -> List[int]:
+        """Replica indices routing may place NEW requests on: not draining,
+        and — when the caller pinned a ``model_version`` — serving exactly
+        that weights label."""
+        return [
+            i for i in range(len(self.engines))
+            if self._ids[i] not in self._draining
+            and (model_version is None
+                 or self.engines[i].weights_version == model_version)
+        ]
+
+    def _choose(self, prompt: np.ndarray, candidates: Sequence[int]) -> tuple:
+        """``(replica_index, affinity_score)`` under the configured policy,
+        restricted to ``candidates`` (admittable indices)."""
         if self.policy == "round_robin":
-            i = self._rr_next % len(self.engines)
+            i = candidates[self._rr_next % len(candidates)]
             self._rr_next += 1
             return i, 0
-        scores = [self._affinity(e, prompt) for e in self.engines]
-        best = max(scores)
+        scores = {i: self._affinity(self.engines[i], prompt) for i in candidates}
+        best = max(scores.values())
         if best > 0:
             # highest score wins; load breaks ties among equals
-            tied = [i for i, sc in enumerate(scores) if sc == best]
+            tied = [i for i, sc in scores.items() if sc == best]
             i = min(tied, key=lambda i: self._load(self.engines[i]))
             return i, best
-        i = min(range(len(self.engines)), key=lambda i: self._load(self.engines[i]))
+        i = min(candidates, key=lambda i: self._load(self.engines[i]))
         return i, 0
 
     # ------------------------------------------------------------ submission
@@ -127,16 +163,32 @@ class ReplicaRouter:
         prompt,
         config=None,
         on_token: Optional[Callable[[Request, int], None]] = None,
+        model_version: Optional[str] = None,
         **kwargs: Any,
     ) -> Request:
         """Route one request to a replica and queue it there.  The returned
-        :class:`Request` carries ``replica`` — the index it landed on — so
-        callers can drive or cancel against the right engine."""
+        :class:`Request` carries ``replica`` — the index it landed on — and
+        ``replica_id`` — its stable identity — so callers can drive or cancel
+        against the right engine even after an earlier replica detaches.
+        ``model_version`` pins the request to replicas serving that weights
+        label (the A/B knob); ``None`` routes across every version."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        idx, score = self._choose(prompt)
+        candidates = self._admittable(model_version)
+        if not candidates:
+            # every replica is draining (or none serves the pinned version):
+            # retriable iff capacity could come back without client changes
+            raise AdmissionError(
+                f"no admittable replica"
+                + (f" serving model version {model_version!r}"
+                   if model_version is not None else "")
+                + f" ({len(self.engines)} attached, "
+                  f"{len(self._draining)} draining)",
+                retriable=model_version is None,
+            )
+        idx, score = self._choose(prompt, candidates)
         # failover ladder: chosen replica first, then the rest by load
         order = [idx] + sorted(
-            (i for i in range(len(self.engines)) if i != idx),
+            (i for i in candidates if i != idx),
             key=lambda i: self._load(self.engines[i]),
         )
         last_err: Optional[Exception] = None
@@ -145,10 +197,11 @@ class ReplicaRouter:
                 req = self.engines[i].submit(
                     prompt, config=config, on_token=on_token, **kwargs
                 )
-            except ValueError as exc:
+            except AdmissionError as exc:
                 last_err = exc
                 continue
             req.replica = i
+            req.replica_id = self._ids[i]
             self._routed += 1
             if i == idx and score > 0:
                 self._affinity_hits += 1
@@ -161,13 +214,131 @@ class ReplicaRouter:
         raise last_err  # every replica refused; surface the final reason
 
     def cancel(self, request) -> bool:
-        """Cancel on whichever replica holds the request."""
-        engines = (
-            [self.engines[request.replica]]
-            if getattr(request, "replica", None) is not None
-            else self.engines
+        """Cancel on whichever replica holds the request.  Resolution order:
+        the stable ``replica_id`` (survives detach re-indexing; a request
+        whose replica already detached is necessarily finished — drain waits
+        for it — so that cancel is simply False), then the positional
+        ``replica`` index, then a full scan."""
+        rid = getattr(request, "replica_id", None)
+        if rid is not None:
+            if rid not in self._ids:
+                return False  # its replica drained + detached: request done
+            return self.engines[self._ids.index(rid)].cancel(request)
+        idx = getattr(request, "replica", None)
+        if idx is not None and 0 <= idx < len(self.engines):
+            return self.engines[idx].cancel(request)
+        return any(e.cancel(request) for e in self.engines)
+
+    # ------------------------------------------------------------- elasticity
+    def replica_ids(self) -> List[int]:
+        """Stable ids of the attached replicas, in ``engines`` order."""
+        return list(self._ids)
+
+    def add_replica(self, engine: ServingEngine) -> int:
+        """Attach a freshly built replica; it is admittable immediately.
+        Returns its stable replica id."""
+        self.engines.append(engine)
+        rid = self._next_id
+        self._next_id += 1
+        self._ids.append(rid)
+        self._replicas_gauge.set(float(len(self.engines)))
+        self.recorder.record(
+            "serve/replica_add", replica_id=rid, replicas=len(self.engines),
+            weights_version=engine.weights_version,
         )
-        return any(e.cancel(request) for e in engines)
+        return rid
+
+    def drain_replica(self, replica_id: int) -> None:
+        """Stop routing NEW requests to ``replica_id``.  Everything it
+        already accepted — running lanes AND its queue — runs to completion
+        under the normal drive; once idle, :meth:`step` detaches it.  At
+        least one replica must stay admitting (drain the front door itself
+        by shutting the server down, not by starving the router)."""
+        if replica_id not in self._ids:
+            raise ValueError(f"unknown replica id {replica_id}")
+        remaining = [i for i in self._ids if i not in self._draining]
+        if remaining == [replica_id]:
+            raise ValueError(
+                "cannot drain the last admitting replica; add_replica a "
+                "successor first"
+            )
+        self._draining.add(replica_id)
+        self.recorder.record(
+            "serve/replica_drain", replica_id=replica_id,
+            queue_depth=self.engines[self._ids.index(replica_id)]
+            .scheduler.queue_depth,
+        )
+
+    def detach_replica(self, replica_id: int) -> ServingEngine:
+        """Remove an idle replica and return its engine (callers may keep it
+        warm for re-attach).  Raises if it still has work — use
+        :meth:`drain_replica` + the drive loop to get it idle first."""
+        if replica_id not in self._ids:
+            raise ValueError(f"unknown replica id {replica_id}")
+        i = self._ids.index(replica_id)
+        engine = self.engines[i]
+        if engine.has_work:
+            raise RuntimeError(
+                f"replica {replica_id} still has work "
+                f"(queue={engine.scheduler.queue_depth}); drain it first"
+            )
+        del self.engines[i]
+        del self._ids[i]
+        self._draining.discard(replica_id)
+        self._replicas_gauge.set(float(len(self.engines)))
+        self.recorder.record(
+            "serve/replica_detach", replica_id=replica_id,
+            replicas=len(self.engines),
+        )
+        return engine
+
+    def _reap_drained(self) -> None:
+        """Detach every draining replica that has gone idle."""
+        for rid in [r for r in self._ids if r in self._draining]:
+            if not self.engines[self._ids.index(rid)].has_work:
+                self.detach_replica(rid)
+
+    def hot_swap(self, params: Any, version: Optional[str] = None,
+                 max_steps: int = 100_000, step_fn=None) -> int:
+        """Rolling zero-downtime weight swap: every attached replica, one at
+        a time, pauses admission, drains its lanes while the OTHER replicas
+        keep serving (its own queued requests merely wait and then decode
+        under the new weights), rebinds ``params`` through
+        :meth:`ServingEngine.swap_params` (prefix cache flushed, compiled
+        executables reused), and resumes.  No in-flight request is failed or
+        served by a mixture of weight versions.  ``step_fn`` (default
+        :meth:`step`) is called while waiting for each drain — the HTTP
+        front door passes a hook that also keeps servicing its submit inbox.
+        Returns the number of replicas swapped."""
+        step_fn = step_fn if step_fn is not None else self.step
+        swapped = 0
+        for rid in list(self._ids):
+            if rid not in self._ids or rid in self._draining:
+                continue  # detached or draining mid-rollout: skip
+            engine = self.engines[self._ids.index(rid)]
+            engine.pause_admission()
+            try:
+                steps = 0
+                while not engine.drained:
+                    step_fn()
+                    steps += 1
+                    if steps > max_steps:
+                        raise RuntimeError(
+                            f"replica {rid} did not drain in {max_steps} steps"
+                        )
+                engine.swap_params(params, version=version)
+                swapped += 1
+            finally:
+                engine.resume_admission()
+        return swapped
+
+    def versions(self) -> dict:
+        """``weights_version -> replica count`` over attached replicas (the
+        ``/v1/models`` surface)."""
+        out: dict = {}
+        for e in self.engines:
+            out[e.weights_version] = out.get(e.weights_version, 0) + 1
+        return out
 
     # ----------------------------------------------------------------- drive
     @property
@@ -183,9 +354,10 @@ class ReplicaRouter:
         window while A's device computes, so even the single-threaded drive
         overlaps replicas; ``has_work`` holds until every replica's pipeline
         has drained (an in-flight window counts as work)."""
-        for e in self.engines:
+        for e in list(self.engines):
             if e.has_work:
                 e.step()
+        self._reap_drained()
 
     def run(self, max_steps: Optional[int] = None) -> None:
         steps = 0
@@ -239,14 +411,19 @@ class ReplicaRouter:
             "affinity_hit_rate": (
                 self._affinity_hits / self._routed if self._routed else 0.0
             ),
+            "versions": self.versions(),
             "per_replica": [
                 {
+                    "replica_id": self._ids[i],
                     "queue_depth": e.scheduler.queue_depth,
                     "active_lanes": int(e._active.sum()),
                     "tp_degree": e.tp_degree,
                     "has_work": e.has_work,
+                    "draining": self._ids[i] in self._draining,
+                    "admission_paused": e.admission_paused,
+                    "weights_version": e.weights_version,
                 }
-                for e in self.engines
+                for i, e in enumerate(self.engines)
             ],
         }
 

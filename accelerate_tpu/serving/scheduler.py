@@ -28,6 +28,7 @@ import numpy as np
 
 from ..models.generation import GenerationConfig
 from ..telemetry import get_flight_recorder
+from .errors import AdmissionError
 from .pool import plan_chunks
 
 
@@ -81,6 +82,10 @@ class Request:
     # replica index a :class:`~accelerate_tpu.serving.router.ReplicaRouter`
     # placed this request on (None when submitted straight to an engine)
     replica: Optional[int] = None
+    # stable replica identity: unlike ``replica`` (a position in
+    # ``router.engines``, which shifts when an earlier replica detaches),
+    # this id survives elastic add/drain — cancel resolves through it first
+    replica_id: Optional[int] = None
 
     @property
     def done(self) -> bool:
@@ -126,7 +131,8 @@ class Scheduler:
     """
 
     def __init__(self, prefill_buckets: Sequence[int], prefill_token_budget: int,
-                 prefix_cache=None, recorder=None):
+                 prefix_cache=None, recorder=None,
+                 max_queue: Optional[int] = None):
         self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
         if not self.buckets:
             raise ValueError("need at least one prefill bucket")
@@ -136,6 +142,14 @@ class Scheduler:
                 f"prefill_token_budget {self.budget} cannot fit the smallest "
                 f"bucket {self.buckets[0]} — no prompt would ever be admitted"
             )
+        # admission backpressure: with ``max_queue`` set, a submit that would
+        # push the waiting line past it raises a *retriable* AdmissionError —
+        # the signal the HTTP front door maps to 429 and the router's failover
+        # ladder uses to try a less-loaded replica.  None = unbounded (the
+        # in-process benches/tests drive their own queue depth).
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.queue: deque = deque()
         self.prefilling: Optional[Request] = None
         self.prefix_cache = prefix_cache
@@ -158,6 +172,18 @@ class Scheduler:
         request.cached_chunks = len(nodes)
 
     def submit(self, request: Request) -> None:
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            # retry hint: the queue drains one request per freed slot; a rough
+            # half-second per queued request is deliberately conservative —
+            # callers treat it as "not before", not as a promise
+            depth = self.queue_depth
+            raise AdmissionError(
+                f"admission queue full ({len(self.queue)} >= max_queue "
+                f"{self.max_queue})",
+                queue_depth=depth,
+                retry_after_s=min(30.0, 0.5 * depth),
+                retriable=True,
+            )
         request.chunks = plan_chunks(len(request.prefill_tokens), self.buckets)
         self._match_prefix(request)
         self.queue.append(request)

@@ -54,6 +54,7 @@ from .metrics import (
 )
 from .server import (
     DebugServer,
+    TelemetryEndpoints,
     get_debug_server,
     resolve_metrics_port,
     start_debug_server,
@@ -99,6 +100,7 @@ __all__ = [
     "install_crash_hooks",
     "all_thread_stacks",
     "DebugServer",
+    "TelemetryEndpoints",
     "start_debug_server",
     "get_debug_server",
     "stop_debug_server",

@@ -41,6 +41,7 @@ logger = get_logger(__name__)
 
 __all__ = [
     "DebugServer",
+    "TelemetryEndpoints",
     "start_debug_server",
     "get_debug_server",
     "stop_debug_server",
@@ -71,101 +72,33 @@ def resolve_metrics_port(explicit: Optional[int] = None) -> Optional[int]:
         return None
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # Quiet: route access logs through our logger at debug level instead of
-    # writing to stderr mid-training.
-    def log_message(self, fmt: str, *args: Any) -> None:
-        logger.debug("debug server: " + fmt % args)
+class TelemetryEndpoints:
+    """The telemetry HTTP surface as plain callables, decoupled from any
+    server: registry + recorder + scrape-time collectors, and the body of
+    every route (``/metrics``, ``/healthz``, ``/debug/flight``,
+    ``/debug/stacks``).  :class:`DebugServer` binds it to its own daemon
+    port; the serving front door (:mod:`accelerate_tpu.serving.api`) muxes
+    the SAME routes onto the API port instead of running a second server —
+    one process, one telemetry surface, whichever port you scrape.
 
-    def do_GET(self) -> None:  # noqa: N802 - http.server API
-        debug: "DebugServer" = self.server.debug_server  # type: ignore[attr-defined]
-        parts = urlsplit(self.path)
-        try:
-            if parts.path == "/metrics":
-                self._respond(200, PROMETHEUS_CONTENT_TYPE, debug.render_metrics())
-            elif parts.path == "/healthz":
-                healthy, body = debug.health()
-                self._respond(
-                    200 if healthy else 503,
-                    "application/json",
-                    json.dumps(body, indent=1),
-                )
-            elif parts.path == "/debug/flight":
-                query = parse_qs(parts.query)
-                n = None
-                if "n" in query:
-                    try:
-                        n = int(query["n"][0])
-                    except ValueError:
-                        pass
-                self._respond(
-                    200, "application/json", json.dumps(debug.flight_tail(n), indent=1)
-                )
-            elif parts.path == "/debug/stacks":
-                self._respond(200, "text/plain; charset=utf-8", debug.render_stacks())
-            elif parts.path == "/":
-                self._respond(
-                    200,
-                    "text/plain; charset=utf-8",
-                    "accelerate_tpu debug server\n"
-                    "endpoints: /metrics /healthz /debug/flight /debug/stacks\n",
-                )
-            else:
-                self._respond(404, "text/plain; charset=utf-8", "not found\n")
-        except Exception as exc:  # never take down the scrape thread
-            logger.warning("debug server handler failed", exc_info=True)
-            try:
-                self._respond(500, "text/plain; charset=utf-8", f"error: {exc!r}\n")
-            except Exception:
-                pass
-
-    def _respond(self, code: int, content_type: str, body: str) -> None:
-        payload = body.encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
-
-
-class DebugServer:
-    """Owns the HTTP daemon plus the registry/recorder it exposes."""
+    ``health_extra`` augments the heartbeat check: a callable returning
+    ``(healthy, details)`` merged into the ``/healthz`` body — the front
+    door passes the router's per-replica aggregation, so a single stuck
+    replica flips the endpoint to 503 even while others heartbeat.
+    """
 
     def __init__(
         self,
-        port: int,
-        host: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         recorder: Optional[FlightRecorder] = None,
         unhealthy_after_s: float = 60.0,
+        health_extra: Optional[Callable[[], Tuple[bool, Dict[str, Any]]]] = None,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.recorder = recorder if recorder is not None else get_flight_recorder()
         self.unhealthy_after_s = float(unhealthy_after_s)
+        self.health_extra = health_extra
         self._collectors: List[Callable[[], Any]] = []
-        host = host if host is not None else os.environ.get(METRICS_HOST_ENV, "0.0.0.0")
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
-        self._httpd.daemon_threads = True
-        self._httpd.debug_server = self  # type: ignore[attr-defined]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="atpu-debug-server",
-            daemon=True,
-        )
-        self._thread.start()
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    @property
-    def host(self) -> str:
-        return self._httpd.server_address[0]
-
-    @property
-    def url(self) -> str:
-        host = self.host if self.host not in ("0.0.0.0", "") else "127.0.0.1"
-        return f"http://{host}:{self.port}"
 
     def add_collector(self, fn: Callable[[], Any]) -> None:
         """Register a callable run (best-effort) before each ``/metrics``
@@ -186,12 +119,22 @@ class DebugServer:
     def health(self) -> Tuple[bool, Dict[str, Any]]:
         age = self.recorder.heartbeat_age()
         healthy = age is None or age < self.unhealthy_after_s
-        return healthy, {
+        body: Dict[str, Any] = {
             "healthy": healthy,
             "heartbeat_age_s": age,
             "unhealthy_after_s": self.unhealthy_after_s,
             "events_total": self.recorder.events_total,
         }
+        if self.health_extra is not None:
+            try:
+                extra_ok, extra = self.health_extra()
+            except Exception:
+                logger.warning("health_extra hook failed", exc_info=True)
+                extra_ok, extra = False, {"health_extra": "raised"}
+            healthy = healthy and extra_ok
+            body.update(extra)
+            body["healthy"] = healthy
+        return healthy, body
 
     def flight_tail(self, n: Optional[int] = None) -> Dict[str, Any]:
         return {
@@ -208,6 +151,135 @@ class DebugServer:
             chunks.extend(frames)
             chunks.append("")
         return "\n".join(chunks)
+
+    def handle(self, path: str, query: str = "") -> Tuple[int, str, str]:
+        """Route one GET: ``(status, content_type, body)``, or a 404 triple
+        for paths outside the telemetry surface.  Exists so embedders (the
+        API front door) dispatch with one call instead of re-implementing
+        the route table."""
+        if path == "/metrics":
+            return 200, PROMETHEUS_CONTENT_TYPE, self.render_metrics()
+        if path == "/healthz":
+            healthy, body = self.health()
+            return (200 if healthy else 503, "application/json",
+                    json.dumps(body, indent=1))
+        if path == "/debug/flight":
+            n = None
+            q = parse_qs(query)
+            if "n" in q:
+                try:
+                    n = int(q["n"][0])
+                except ValueError:
+                    pass
+            return 200, "application/json", json.dumps(self.flight_tail(n), indent=1)
+        if path == "/debug/stacks":
+            return 200, "text/plain; charset=utf-8", self.render_stacks()
+        return 404, "text/plain; charset=utf-8", "not found\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Quiet: route access logs through our logger at debug level instead of
+    # writing to stderr mid-training.
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug("debug server: " + fmt % args)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        debug: "DebugServer" = self.server.debug_server  # type: ignore[attr-defined]
+        parts = urlsplit(self.path)
+        try:
+            if parts.path == "/":
+                self._respond(
+                    200,
+                    "text/plain; charset=utf-8",
+                    "accelerate_tpu debug server\n"
+                    "endpoints: /metrics /healthz /debug/flight /debug/stacks\n",
+                )
+            else:
+                code, ctype, body = debug.endpoints.handle(parts.path, parts.query)
+                self._respond(code, ctype, body)
+        except Exception as exc:  # never take down the scrape thread
+            logger.warning("debug server handler failed", exc_info=True)
+            try:
+                self._respond(500, "text/plain; charset=utf-8", f"error: {exc!r}\n")
+            except Exception:
+                pass
+
+    def _respond(self, code: int, content_type: str, body: str) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class DebugServer:
+    """Owns the HTTP daemon plus the :class:`TelemetryEndpoints` it exposes."""
+
+    def __init__(
+        self,
+        port: int,
+        host: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        recorder: Optional[FlightRecorder] = None,
+        unhealthy_after_s: float = 60.0,
+    ):
+        self.endpoints = TelemetryEndpoints(
+            registry=registry, recorder=recorder,
+            unhealthy_after_s=unhealthy_after_s,
+        )
+        host = host if host is not None else os.environ.get(METRICS_HOST_ENV, "0.0.0.0")
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.debug_server = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="atpu-debug-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # endpoint state + bodies delegate to the shared surface so existing
+    # callers (tests, engine wiring) keep their one-object view
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self.endpoints.registry
+
+    @property
+    def recorder(self) -> FlightRecorder:
+        return self.endpoints.recorder
+
+    @property
+    def unhealthy_after_s(self) -> float:
+        return self.endpoints.unhealthy_after_s
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def url(self) -> str:
+        host = self.host if self.host not in ("0.0.0.0", "") else "127.0.0.1"
+        return f"http://{host}:{self.port}"
+
+    def add_collector(self, fn: Callable[[], Any]) -> None:
+        self.endpoints.add_collector(fn)
+
+    def render_metrics(self) -> str:
+        return self.endpoints.render_metrics()
+
+    def health(self) -> Tuple[bool, Dict[str, Any]]:
+        return self.endpoints.health()
+
+    def flight_tail(self, n: Optional[int] = None) -> Dict[str, Any]:
+        return self.endpoints.flight_tail(n)
+
+    def render_stacks(self) -> str:
+        return self.endpoints.render_stacks()
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -1121,6 +1121,303 @@ def _kernel_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _http_ab_bench(args, model, cfg, params, preset):
+    """Over-the-wire A/B of the OpenAI front door against the in-process engine.
+
+    Four arms over one workload, each a HARD check (SystemExit on failure):
+
+    * identity — concurrent greedy ``POST /v1/completions`` must return
+      token-identical outputs to the same engine driven in-process
+      (``eng.serve``) before the HTTP stack was attached;
+    * streaming — every streamed request's first SSE token chunk must arrive
+      strictly before its own completion ([DONE]) — TTFT < full latency;
+    * flood — a burst far past ``max_queue`` must surface >= 1 HTTP 429
+      (with Retry-After) and NOTHING but 200/429: admission refusals never
+      become engine errors, and every 200 stays token-identical;
+    * hot-swap — workers keep requests in flight while the main thread
+      rolls new weights through ``FrontDoor.hot_swap``; zero failed
+      requests, and every response must equal ENTIRELY the old-weights or
+      ENTIRELY the new-weights in-process reference (the drain barrier
+      means no request ever sees both).
+
+    ``value`` is over-the-wire tokens/s; ``vs_baseline`` divides by the
+    in-process ``eng.serve`` tokens/s on the same workload — the full HTTP +
+    SSE + ticket-crossing overhead in one ratio.
+    """
+    import http.client
+    import threading
+
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ReplicaRouter, ServingEngine
+    from accelerate_tpu.serving.api import ApiServer, FrontDoor
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)
+    slots = args.batch
+    window = args.decode_window
+    max_len = cfg.max_seq_len
+    mp = max(8, min(args.seq, max_len) // 4)
+    buckets = tuple(sorted({max(8, mp // 2), mp}))
+    new_tokens = 4 * window                    # >= 2 decode windows: the first
+    n = args.requests                          # SSE chunk beats [DONE]
+
+    r = np.random.default_rng(args.serve_seed)
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, n)), 4, mp
+    ).astype(int)
+    prompts = [r.integers(1, cfg.vocab_size, (int(k),)).astype(np.int32)
+               for k in prompt_lens]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    useful_tokens = n * new_tokens
+
+    # the queue must hold the whole in-process reference workload (serve()
+    # submits every request before stepping); the flood arm scales past it
+    mq = max(8, slots, n)
+    registry = MetricsRegistry()
+    eng = ServingEngine(
+        model, params, num_slots=slots, max_len=min(max_len, mp + new_tokens + window),
+        prefill_buckets=buckets, max_prompt_len=mp, decode_window=window,
+        registry=registry, max_queue=mq,
+    )
+    warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets]
+    eng.serve(warm, GenerationConfig(max_new_tokens=window))
+
+    # in-process reference + baseline timing: same engine, same executables
+    t0 = time.perf_counter()
+    reqs = eng.serve(prompts, [gen] * n)
+    dt_inproc = time.perf_counter() - t0
+    old_ref = [[int(t) for t in q.tokens] for q in reqs]
+
+    router = ReplicaRouter([eng])
+    fd = FrontDoor(router, model_name=f"bench-{preset}").start()
+    srv = ApiServer(fd, registry=registry)
+    host, port = srv.host, srv.port
+
+    def post_json(path, payload, timeout=600.0):
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("POST", path, json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            return resp.status, dict(resp.getheaders()), json.loads(raw)
+        finally:
+            conn.close()
+
+    def completion(i, max_tokens=new_tokens):
+        return post_json("/v1/completions", {
+            "prompt": [int(t) for t in prompts[i]],
+            "max_tokens": max_tokens, "temperature": 0,
+        })
+
+    def fanout(fn, work):
+        """Run ``fn(*item)`` for every work item on its own thread."""
+        out = [None] * len(work)
+
+        def run(k, item):
+            try:
+                out[k] = fn(*item)
+            except Exception as exc:  # surfaced as a hard bench failure
+                out[k] = exc
+
+        threads = [threading.Thread(target=run, args=(k, item), daemon=True)
+                   for k, item in enumerate(work)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errs = [o for o in out if isinstance(o, Exception)]
+        if errs:
+            raise SystemExit(f"--http-ab: client transport error: {errs[0]!r}")
+        return out
+
+    # ---- arm 1: identity (concurrent, timed — the throughput number)
+    t0 = time.perf_counter()
+    responses = fanout(completion, [(i,) for i in range(n)])
+    dt_http = time.perf_counter() - t0
+    for i, (status, _, body) in enumerate(responses):
+        if status != 200:
+            raise SystemExit(f"--http-ab identity: request {i} got HTTP "
+                             f"{status}: {body}")
+        got = body["choices"][0]["token_ids"]
+        if got != old_ref[i]:
+            raise SystemExit(
+                f"--http-ab identity: request {i} over-the-wire tokens "
+                f"{got[:8]}... != in-process {old_ref[i][:8]}..."
+            )
+
+    # ---- arm 2: streaming — TTFT strictly before the same request's [DONE]
+    def stream_one(i):
+        conn = http.client.HTTPConnection(host, port, timeout=600.0)
+        try:
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/completions", json.dumps({
+                "prompt": [int(t) for t in prompts[i]],
+                "max_tokens": new_tokens, "temperature": 0, "stream": True,
+            }), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise SystemExit(f"--http-ab stream: request {i} got HTTP "
+                                 f"{resp.status}")
+            toks, t_first, saw_done = [], None, False
+            for raw in iter(resp.readline, b""):
+                line = raw.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[len(b"data: "):]
+                if data == b"[DONE]":
+                    saw_done = True
+                    break
+                ids = json.loads(data)["choices"][0]["token_ids"]
+                if ids and t_first is None:
+                    t_first = time.perf_counter() - t0
+                toks.extend(int(t) for t in ids)
+            return t_first, time.perf_counter() - t0, toks, saw_done
+        finally:
+            conn.close()
+
+    n_stream = min(n, 8)
+    ttfts, fulls = [], []
+    for i in range(n_stream):
+        ttft, full, toks, saw_done = stream_one(i)
+        if not saw_done:
+            raise SystemExit(f"--http-ab stream: request {i} never got the "
+                             "data: [DONE] terminator")
+        if toks != old_ref[i]:
+            raise SystemExit(f"--http-ab stream: request {i} streamed tokens "
+                             "diverge from the in-process reference")
+        if ttft is None or not ttft < full:
+            raise SystemExit(
+                f"--http-ab stream: request {i} first token at "
+                f"{ttft}s did not beat its own completion ({full:.3f}s) — "
+                "SSE is buffering the whole response"
+            )
+        ttfts.append(ttft)
+        fulls.append(full)
+
+    # ---- arm 3: flood — burst far past max_queue; 429s, never engine errors
+    flood_n = 6 * mq
+    flood = fanout(lambda i: completion(i % n, window),
+                   [(i,) for i in range(flood_n)])
+    n_429 = sum(1 for status, _, _ in flood if status == 429)
+    bad = [(status, body) for status, _, body in flood
+           if status not in (200, 429)]
+    if bad:
+        raise SystemExit(f"--http-ab flood: non-200/429 response: {bad[0]}")
+    if n_429 < 1:
+        raise SystemExit(
+            f"--http-ab flood: {flood_n} concurrent requests against "
+            f"max_queue={mq} produced zero 429s — backpressure is not wired"
+        )
+    for status, headers, _ in flood:
+        if status == 429 and "Retry-After" not in headers:
+            raise SystemExit("--http-ab flood: 429 without a Retry-After hint")
+    for k, (status, _, body) in enumerate(flood):
+        if status == 200 and body["choices"][0]["token_ids"] != old_ref[k % n][:window]:
+            raise SystemExit(f"--http-ab flood: admitted request {k} returned "
+                             "corrupted tokens under load")
+
+    # ---- arm 4: hot-swap under fire — zero failed, zero mixed-weight outputs
+    params2 = jax.tree_util.tree_map(lambda x: x * 1.01, params)
+    n_probe = min(n, 8)
+    swap_results = []
+    swap_lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(widx):
+        k = 0
+        while not stop.is_set():
+            i = (widx + k) % n_probe
+            k += 1
+            status, _, body = completion(i)
+            with swap_lock:
+                swap_results.append((i, status, body))
+
+    workers = [threading.Thread(target=hammer, args=(w,), daemon=True)
+               for w in range(3)]
+    for t in workers:
+        t.start()
+    time.sleep(0.2)                      # get requests genuinely in flight
+    n_swapped = fd.hot_swap(params2, version="v1")
+    time.sleep(0.2)                      # a few post-swap requests too
+    stop.set()
+    for t in workers:
+        t.join()
+    if n_swapped != 1:
+        raise SystemExit(f"--http-ab hot-swap: swapped {n_swapped} replicas, "
+                         "expected 1")
+
+    srv.stop()
+    fd.stop()
+    # the engine is single-threaded again: new-weights in-process reference
+    new_reqs = eng.serve([prompts[i] for i in range(n_probe)], [gen] * n_probe)
+    new_ref = [[int(t) for t in q.tokens] for q in new_reqs]
+    n_old = n_new = 0
+    for i, status, body in swap_results:
+        if status != 200:
+            raise SystemExit(f"--http-ab hot-swap: in-flight request failed "
+                             f"with HTTP {status}: {body}")
+        got = body["choices"][0]["token_ids"]
+        if got == old_ref[i]:
+            n_old += 1
+        elif got == new_ref[i]:
+            n_new += 1
+        else:
+            raise SystemExit(
+                f"--http-ab hot-swap: probe {i} returned tokens matching "
+                "NEITHER weights version entirely — a request crossed the "
+                "swap barrier mid-decode"
+            )
+    if not swap_results:
+        raise SystemExit("--http-ab hot-swap: no requests were in flight")
+
+    http_tps = useful_tokens / dt_http
+    snap = registry.snapshot()
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "num_slots": slots,
+        "decode_window": window,
+        "max_queue": mq,
+        "new_tokens_per_request": new_tokens,
+        "useful_tokens": useful_tokens,
+        "http_wall_s": round(dt_http, 3),
+        "inproc_wall_s": round(dt_inproc, 3),
+        "inproc_tokens_per_s": round(useful_tokens / dt_inproc, 2),
+        "outputs_token_identical": True,       # hard-checked above
+        "streaming": {
+            "requests": n_stream,
+            "ttft_p50_s": round(float(np.median(ttfts)), 4),
+            "full_p50_s": round(float(np.median(fulls)), 4),
+            "ttft_beats_completion": True,     # hard-checked above
+        },
+        "flood": {
+            "requests": flood_n,
+            "http_429": n_429,
+            "http_200": sum(1 for s, _, _ in flood if s == 200),
+            "engine_errors": 0,                # hard-checked above
+        },
+        "hot_swap": {
+            "replicas_swapped": n_swapped,
+            "in_flight_requests": len(swap_results),
+            "served_old_weights": n_old,
+            "served_new_weights": n_new,
+            "failed": 0,                       # hard-checked above
+        },
+        "http_requests_total": int(snap.get("serve/http_requests_total", 0)),
+        "http_429_total": int(snap.get("serve/http_429_total", 0)),
+        "hot_swaps_total": int(snap.get("serve/hot_swaps_total", 0)),
+    }
+    return {
+        "metric": "http_serving_tokens_per_sec",
+        "value": round(http_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(http_tps / (useful_tokens / dt_inproc), 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -1143,11 +1440,15 @@ def _serve_bench(args, model, cfg, params, preset):
             bool(getattr(args, "kernel_ab", False)),
             bool(getattr(args, "tp_ab", False)),
             bool(getattr(args, "async_ab", False)),
+            bool(getattr(args, "http_ab", False)),
             bool(args.shared_prefix)]) > 1:
-        raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab and "
-                         "--shared-prefix are separate serve workloads; pick one")
+        raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab, "
+                         "--http-ab and --shared-prefix are separate serve "
+                         "workloads; pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "http_ab", False):
+        return _http_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "kernel_ab", False):
         return _kernel_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "tp_ab", False):
@@ -1360,6 +1661,13 @@ def main():
                              "speculative/paged/int8-KV arms, >= 10% tokens/s "
                              "on the streaming greedy arm, overlap gauge > 0, "
                              "and an unchanged compiled-executable budget")
+    parser.add_argument("--http-ab", dest="http_ab", action="store_true",
+                        help="--task serve: drive the OpenAI front door over "
+                             "the wire — token-identity vs in-process submit, "
+                             "per-request SSE TTFT < completion, a 429 flood "
+                             "with zero engine errors, and a mid-bench weight "
+                             "hot-swap with zero failed or mixed-weight "
+                             "in-flight requests (all hard checks)")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",
                         help="--kernel-ab: quantized KV page format for the "

@@ -1,0 +1,14 @@
+"""Fixture: HTTP handler code that crosses into the engine directly —
+every shape the handler-blocking rule must catch."""
+
+import jax
+
+from accelerate_tpu.serving.engine import ServingEngine
+
+
+class Handler:
+    def do_POST(self):
+        req = self.server.frontdoor.router.submit(prompt=[1, 2, 3])
+        while self.server.frontdoor.router.engines[0].has_work:
+            self.server.frontdoor.router.step()
+        return jax.device_get(req.generated)

@@ -1,0 +1,22 @@
+"""Fixture: handler crossing through the sanctioned FrontDoor ticket API —
+submit, TokenStream.get, cancel.  The handler-blocking rule stays silent."""
+
+from accelerate_tpu.serving.errors import AdmissionError
+
+
+class Handler:
+    def do_POST(self, call):
+        try:
+            rid, stream = self.server.api.frontdoor.submit(call, None)
+        except AdmissionError:
+            raise
+        tokens = []
+        while True:
+            tok = stream.get(timeout=0.5)
+            if tok is None:
+                break
+            tokens.append(tok)
+        return tokens
+
+    def do_DELETE(self, rid):
+        return self.server.api.frontdoor.cancel(rid)

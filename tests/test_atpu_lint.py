@@ -29,6 +29,7 @@ FIX = REPO / "tests" / "fixtures" / "lint"
 EXPECTED_RULE_IDS = {
     "bare-print",
     "blocking-readback",
+    "handler-blocking",
     "method-lru-cache",
     "pallas-interpret",
     "metric-docs",
@@ -61,6 +62,8 @@ def test_registry_is_complete():
         ("bare-print", "bare_print_bad.py", 2, "bare_print_clean.py"),
         ("blocking-readback", "blocking_readback_bad.py", 3,
          "blocking_readback_clean.py"),
+        ("handler-blocking", "handler_blocking_bad.py", 5,
+         "handler_blocking_clean.py"),
         ("method-lru-cache", "method_lru_cache_bad.py", 2,
          "method_lru_cache_clean.py"),
         ("pallas-interpret", "pallas_interpret_bad.py", 1,

@@ -9,6 +9,7 @@ from typing import Dict, List, Optional, Sequence, Type
 from ..core import Rule
 from .bare_print import BarePrintRule
 from .blocking_readback import BlockingReadbackRule
+from .handler_blocking import HandlerBlockingRule
 from .implicit_host_sync import ImplicitHostSyncRule
 from .jit_signature_drift import JitSignatureDriftRule
 from .metric_docs import MetricDocsRule
@@ -22,6 +23,7 @@ from .use_after_donate import UseAfterDonateRule
 ALL_RULES: List[Type[Rule]] = [
     BarePrintRule,
     BlockingReadbackRule,
+    HandlerBlockingRule,
     MethodLruCacheRule,
     PallasInterpretRule,
     MetricDocsRule,

@@ -1,0 +1,105 @@
+"""Rule ``handler-blocking``: HTTP handler threads cross into the engine
+only through the sanctioned FrontDoor API.
+
+The front door's threading contract (``accelerate_tpu/serving/api/``) is
+that every engine host-state mutation happens on the one FrontDoor driver
+thread; ``ThreadingHTTPServer`` handler threads talk to it exclusively via
+the ticket API (``submit`` / ``cancel`` / ``hot_swap`` / ...) and the
+per-request :class:`~accelerate_tpu.serving.api.frontdoor.TokenStream`
+queues.  A handler that reaches through to ``router.step()``, pokes an
+``engine`` attribute, or blocks on a device readback races the driver and
+corrupts slot state — and, like a stray ``device_get`` in the serve loop,
+it usually still produces correct tokens in a single-threaded test.
+
+Three shapes are flagged inside ``accelerate_tpu/serving/api/`` (with
+``frontdoor.py`` itself exempt — it *is* the sanctioned crossing point):
+
+* imports of serving internals (``engine``, ``router``, ``scheduler``, the
+  executable pool) — handler modules may import ``errors`` and the api
+  package only;
+* attribute chains that use ``engine`` / ``engines`` / ``router`` /
+  ``scheduler`` as a receiver (``frontdoor.router.submit(...)``);
+* blocking device materialization (``device_get`` / ``block_until_ready`` /
+  ``fetch``) — handler threads block on ``TokenStream.get`` and nothing
+  else.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import dotted
+
+#: calls that materialize device state — handler threads never block on these
+BLOCKING_NAMES = ("device_get", "block_until_ready", "fetch")
+#: receiver names that mean the chain reached past FrontDoor into the engine
+ENGINE_RECEIVERS = ("engine", "engines", "router", "scheduler")
+#: serving-internal module tails only frontdoor.py may import
+FORBIDDEN_IMPORT_TAILS = (
+    "engine", "router", "scheduler", "pool", "paging", "prefix_cache",
+    "readback", "spec",
+)
+
+
+def _chain(node: ast.AST) -> Optional[List[str]]:
+    name = dotted(node)
+    return name.split(".") if name else None
+
+
+class HandlerBlockingRule(Rule):
+    id = "handler-blocking"
+    summary = ("HTTP handlers cross into the engine only via the FrontDoor "
+               "submit/cancel/queue API")
+
+    def applies_to(self, rel: str) -> bool:
+        return (
+            rel.startswith("accelerate_tpu/serving/api/")
+            and not rel.endswith("/frontdoor.py")
+        )
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        out = {}
+
+        def flag(node: ast.AST, message: str) -> None:
+            # one diagnostic per line: a Call and its Attribute func both match
+            out.setdefault(
+                node.lineno, Diagnostic(ctx.rel, node.lineno, self.id, message)
+            )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                module = node.module or ""
+                tail = module.rsplit(".", 1)[-1]
+                if tail in FORBIDDEN_IMPORT_TAILS and (
+                    node.level >= 1 or "serving" in module
+                ):
+                    flag(node,
+                         f"handler module imports serving internals "
+                         f"({module}) — only frontdoor.py crosses into the "
+                         "engine; handlers use the FrontDoor API")
+                continue
+            if isinstance(node, ast.Call):
+                parts = _chain(node.func)
+            elif isinstance(node, ast.Attribute):
+                # the attribute access itself is the crossing: passing
+                # ``frontdoor.router`` around escapes just as hard when used
+                parts = _chain(node)
+            else:
+                continue
+            if not parts:
+                continue
+            tail = parts[-1]
+            if tail in BLOCKING_NAMES:
+                flag(node,
+                     f"blocking device readback ({tail}) on an HTTP handler "
+                     "thread — handlers block only on TokenStream.get; the "
+                     "FrontDoor driver owns all device materialization")
+            elif any(seg in ENGINE_RECEIVERS for seg in parts[:-1]):
+                flag(node,
+                     f"direct engine crossing ({'.'.join(parts)}) from a "
+                     "handler thread — route through the FrontDoor "
+                     "submit/cancel/ticket API (frontdoor.py is the "
+                     "sanctioned crossing point)")
+        return [out[k] for k in sorted(out)]
