@@ -1,7 +1,7 @@
 # Developer targets (reference Makefile:25-72 test split analog).
 
 .PHONY: test test_fast test_slow test_core test_big_modeling test_cli test_examples \
-        test_multiprocess test_kernels native bench bench-serve quality lint-json
+        test_multiprocess test_kernels native bench bench-serve chaos quality lint-json
 
 test:
 	python -m pytest tests/ -q
@@ -54,7 +54,15 @@ bench-serve:
 	python bench_inference.py --task serve --tp-ab
 	python bench_inference.py --task serve --async-ab
 	python bench_inference.py --task serve --http-ab
+	python bench_inference.py --task serve --chaos-ab
 	python bench_inference.py --task spec
+
+# fault-tolerance gate: the deterministic fault-injection test suite plus the
+# chaos A/B (replica kill -> token-identical replay, seeded fault soak, and a
+# faults-off overhead check; every check in the bench is a hard SystemExit)
+chaos:
+	python -m pytest tests/test_fault_tolerance.py -q
+	python bench_inference.py --task serve --chaos-ab
 
 # one process, one AST load per file, all ten rules (tools/atpu_lint/rules/);
 # the lint surface includes the linter itself (docs/development/static-analysis.md)

@@ -6,7 +6,8 @@ pool becomes a refcounted page pool behind per-lane block tables
 """
 
 from .engine import ServingEngine
-from .errors import AdmissionError
+from .errors import AdmissionError, DeadlineExceeded
+from .faults import FaultInjected, FaultInjector, FaultPlan
 from .paging import NULL_PAGE, PageAllocator, PagedKVPool
 from .pool import (
     ServeShardings,
@@ -30,6 +31,10 @@ from .spec import propose_ngram_draft
 __all__ = [
     "ServingEngine",
     "AdmissionError",
+    "DeadlineExceeded",
+    "FaultInjected",
+    "FaultInjector",
+    "FaultPlan",
     "ReplicaRouter",
     "ServeShardings",
     "Request",

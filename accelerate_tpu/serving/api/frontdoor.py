@@ -36,7 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ...logging import get_logger
 from ...models.generation import GenerationConfig
 from ...telemetry import get_flight_recorder
-from ..errors import AdmissionError
+from ..errors import AdmissionError, DeadlineExceeded
 from ..router import ReplicaRouter
 from ..scheduler import Request, RequestState
 from .protocol import CompletionCall
@@ -141,6 +141,11 @@ class FrontDoor:
         self.ticket_timeout_s = float(ticket_timeout_s)
         self.recorder = get_flight_recorder()
         self._tickets: "queue.Queue[_Ticket]" = queue.Queue()
+        # keyed by a front-door-minted id, NOT ``req.rid``: engine rids are
+        # per-replica counters (and rewritten by failover adoption), so two
+        # replicas' rids collide here and the clobbered entry's stream would
+        # never be reaped — its handler would hang until the client timeout
+        self._next_key = 0
         self._outstanding: Dict[int, Tuple[Request, TokenStream]] = {}
         self._stop = threading.Event()
         self._in_admin = False
@@ -203,18 +208,20 @@ class FrontDoor:
 
             req = self.router.submit(
                 call.prompt, config=gen, on_token=on_token,
-                model_version=model_version,
+                model_version=model_version, deadline_s=call.deadline_s,
             )
-            stream = TokenStream(req.rid)
+            self._next_key += 1
+            stream = TokenStream(self._next_key)
             stream_box.append(stream)
-            self._outstanding[req.rid] = (req, stream)
+            self._outstanding[stream.rid] = (req, stream)
             return req, stream
 
         return self._call(_do)
 
     def cancel(self, rid: int) -> bool:
-        """Cancel by engine request id (queued or running).  The stream
-        closes on the driver's next reap pass."""
+        """Cancel by front-door request id — the ``stream.rid`` handed back
+        from :meth:`submit` and echoed to clients (queued or running).  The
+        stream closes on the driver's next reap pass."""
 
         def _do() -> bool:
             entry = self._outstanding.get(rid)
@@ -290,7 +297,19 @@ class FrontDoor:
         ]
         for rid in finished:
             req, stream = self._outstanding.pop(rid)
-            stream.close(req.tokens, req.state)
+            if req.deadline_exceeded:
+                # the engine's deadline sweep cancelled it — close with the
+                # typed error so the edge answers 504, not a silent truncation
+                stream.close(
+                    req.tokens, req.state,
+                    error=DeadlineExceeded(
+                        f"request {rid} exceeded its {req.deadline_s}s "
+                        f"deadline after {len(req.tokens)} tokens",
+                        deadline_s=req.deadline_s or 0.0,
+                    ),
+                )
+            else:
+                stream.close(req.tokens, req.state)
 
     def _process_tickets(self, skip_admin: bool = False) -> None:
         deferred: List[_Ticket] = []

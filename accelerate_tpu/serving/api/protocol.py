@@ -71,6 +71,10 @@ class CompletionCall:
     model: Optional[str] = None
     echo: bool = False
     chat: bool = False
+    # SLO budget in seconds from submit: admission sheds (429) when the
+    # queue estimate says it is unmeetable, and a running lane that blows it
+    # is cancelled with a 504 (engine-side deadline sweep)
+    deadline_s: Optional[float] = None
 
 
 def _require_dict(body: Any) -> Dict[str, Any]:
@@ -149,6 +153,7 @@ def _common_fields(body: Dict[str, Any]) -> Dict[str, Any]:
         stop_token_id=stop_token_id,
         stream=stream,
         model=model,
+        deadline_s=_number(body, "deadline_s", None, 0.001, 3600.0),
     )
 
 

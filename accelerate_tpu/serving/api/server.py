@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -40,7 +41,8 @@ from urllib.parse import urlsplit
 
 from ...logging import get_logger
 from ...telemetry import MetricsRegistry, TelemetryEndpoints, get_registry
-from ..errors import AdmissionError
+from .. import faults
+from ..errors import AdmissionError, DeadlineExceeded
 from .frontdoor import FrontDoor
 from .protocol import (
     SSE_DONE,
@@ -61,6 +63,13 @@ __all__ = ["ApiServer"]
 
 #: Max accepted request body (token-id prompts are compact; 8 MiB is ample).
 MAX_BODY_BYTES = 8 << 20
+
+
+def _retry_after(seconds: float) -> str:
+    """``Retry-After`` header value with +-25% jitter: a flood refused in the
+    same instant must not retry in the same instant — synchronized retries
+    would re-flood admission exactly one hint later."""
+    return str(max(1, int(seconds * (0.75 + 0.5 * random.random()) + 0.5)))
 
 
 def _request_id(call: CompletionCall, rid: int) -> str:
@@ -189,6 +198,13 @@ class _ApiHandler(BaseHTTPRequestHandler):
                                        param=exc.param))
         except AdmissionError as exc:
             self._admission_refused(exc)
+        except TimeoutError as exc:
+            # the driver didn't pick up the ticket in time: the engine is
+            # wedged or saturated, but the condition is transient — tell the
+            # client to come back, not that the server is broken
+            self._send(503, error_body(
+                str(exc), "service_unavailable", code="driver_busy",
+            ), extra_headers={"Retry-After": _retry_after(5.0)})
         except Exception as exc:
             self._safe_error(exc)
         finally:
@@ -201,7 +217,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
             api.http_429.inc()
             headers = {}
             if exc.retry_after_s is not None:
-                headers["Retry-After"] = str(max(1, int(exc.retry_after_s + 0.5)))
+                headers["Retry-After"] = _retry_after(exc.retry_after_s)
             self._send(429, error_body(
                 str(exc), "rate_limit_error", code="engine_overloaded",
             ), extra_headers=headers)
@@ -216,18 +232,25 @@ class _ApiHandler(BaseHTTPRequestHandler):
         api = self.api
         version = api.frontdoor.resolve_model(call.model)
         req, stream = api.frontdoor.submit(call, model_version=version)
-        request_id = _request_id(call, req.rid)
+        # address the request by the front door's id, not req.rid: engine
+        # rids are per-replica and rewritten on failover adoption
+        request_id = _request_id(call, stream.rid)
         created = int(time.time())
         model = call.model or api.frontdoor.model_name
         if call.stream:
-            self._stream_response(call, req.rid, stream, request_id, created,
-                                  model)
+            self._stream_response(call, stream.rid, stream, request_id,
+                                  created, model)
             return
         if not stream.wait_done(api.request_timeout_s):
-            api.frontdoor.cancel(req.rid)
+            api.frontdoor.cancel(stream.rid)
             self._send(504, error_body(
                 f"generation exceeded {api.request_timeout_s}s",
                 "timeout_error",
+            ))
+            return
+        if isinstance(stream.error, DeadlineExceeded):
+            self._send(504, error_body(
+                str(stream.error), "timeout_error", code="deadline_exceeded",
             ))
             return
         if stream.error is not None:
@@ -266,6 +289,11 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     return
                 if token is None:
                     break
+                if (faults.ACTIVE is not None
+                        and faults.ACTIVE.fire("handler_disconnect")):
+                    # stand-in for the client's socket dying mid-stream: the
+                    # except below must cancel the lane and free its pages
+                    raise BrokenPipeError("injected SSE client disconnect")
                 self.wfile.write(sse_frame(completion_chunk(
                     call, request_id, created, model, token, first,
                     decode=api.decode,
@@ -301,7 +329,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
         try:
             self._send(500, error_body(f"internal error: {exc!r}",
                                        "internal_error"))
-        except Exception:
+        except Exception:  # noqa: swallowed-exception (client socket is gone)
             pass
 
 

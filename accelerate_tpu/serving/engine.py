@@ -58,6 +58,7 @@ from ..telemetry import (
     get_tracer,
     start_debug_server,
 )
+from . import faults
 from .errors import AdmissionError
 from .paging import PagedKVPool
 from .pool import (
@@ -580,6 +581,8 @@ class ServingEngine:
             "cow_copies": 0,
             "prefreed_lanes": 0,
             "hot_swaps": 0,
+            "deadline_shed": 0,
+            "requests_replayed": 0,
         }
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
@@ -672,6 +675,15 @@ class ServingEngine:
                  "dispatch); grows every step under async_depth=0, stays "
                  "near-flat once the depth-1 pipeline fills",
         )
+        # fault containment: the first exception to escape a step parks here
+        # and every later step() re-raises it — a poisoned engine never
+        # half-runs.  The router supervisor reads it to trigger ejection.
+        self._poisoned: Optional[BaseException] = None
+        # deadline shedding: EMA of request wall time (admission's
+        # queue-depth feasibility estimate) and a flag that keeps the
+        # per-step deadline sweep off the hot path until a deadline exists
+        self._service_ema = 0.0
+        self._has_deadlines = False
 
     def _bump(self, key: str, n: int = 1) -> None:
         self.stats[key] += n
@@ -703,6 +715,7 @@ class ServingEngine:
         on_token: Optional[Callable[[Request, int], None]] = None,
         cache_prefix: bool = True,
         speculate: bool = True,
+        deadline_s: Optional[float] = None,
         **overrides: Any,
     ) -> Request:
         """Queue one request; returns its :class:`Request` handle (filled in
@@ -712,7 +725,10 @@ class ServingEngine:
         population (e.g. prompts carrying secrets that must not be retained);
         ``speculate=False`` opts it out of n-gram drafting (it still rides
         along in verify windows other lanes trigger — with pad drafts, which
-        verification rejects)."""
+        verification rejects).  ``deadline_s`` is an SLO budget from submit:
+        admission sheds (retriable refusal) when the queue-depth estimate
+        says it cannot be met, and the per-step deadline sweep cancels the
+        request (``deadline_exceeded`` set) if a running lane blows it."""
         gen = config or GenerationConfig()
         if overrides:
             gen = dataclasses.replace(gen, **overrides)
@@ -749,13 +765,37 @@ class ServingEngine:
                 queue_depth=self.scheduler.queue_depth,
                 retriable=False,
             )
+        if deadline_s is not None:
+            # feasibility check against the waiting line: each queued request
+            # costs ~one observed end-to-end service time (EMA) before this
+            # one's lane even starts.  Optimistic before the first completion
+            # (EMA 0 admits everything); a shed is retriable — the queue
+            # drains, the same deadline may be meetable in a moment.
+            est = self.scheduler.queue_depth * self._service_ema
+            if est > float(deadline_s):
+                self._bump("deadline_shed")
+                self.recorder.record(
+                    "serve/deadline_shed", where="admission",
+                    deadline_s=float(deadline_s), estimate_s=est,
+                    queue_depth=self.scheduler.queue_depth,
+                )
+                raise AdmissionError(
+                    f"deadline {deadline_s}s unmeetable: ~{est:.2f}s of queued "
+                    f"work ahead ({self.scheduler.queue_depth} requests)",
+                    queue_depth=self.scheduler.queue_depth,
+                    retry_after_s=min(30.0, max(est - float(deadline_s), 0.1)),
+                    retriable=True,
+                )
         now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
                       submit_step=self._step_count, submit_time=now, last_token_time=now,
-                      cache_prefix=bool(cache_prefix), speculate=bool(speculate))
+                      cache_prefix=bool(cache_prefix), speculate=bool(speculate),
+                      deadline_s=None if deadline_s is None else float(deadline_s))
         self._next_rid += 1
         self.scheduler.submit(req)
         self._bump("requests_submitted")
+        if deadline_s is not None:
+            self._has_deadlines = True
         return req
 
     def cancel(self, request) -> bool:
@@ -839,6 +879,12 @@ class ServingEngine:
                 "step until engine.drained): active lanes or an in-flight "
                 "window would mix weight versions mid-request"
             )
+        if faults.ACTIVE is not None and faults.ACTIVE.fire("hot_swap_upload"):
+            # fail BEFORE touching any state: a torn upload must leave the
+            # engine serving the old weights intact, cache included
+            raise faults.FaultInjected(
+                "injected hot-swap upload failure (weights unchanged)"
+            )
         if self.prefix_cache is not None:
             # queued requests hold pins from admission-time matching; drop
             # them (they re-match against fresh KV at prefill) so flush can
@@ -870,6 +916,197 @@ class ServingEngine:
             "serve/hot_swap", old_version=old, new_version=self.weights_version,
             step=self._step_count, cache_nodes_flushed=flushed,
         )
+
+    # -------------------------------------------------------- fault tolerance
+    def kill(self, reason: str = "replica killed") -> None:
+        """Poison this engine as if its device vanished mid-window: every
+        subsequent :meth:`step` raises without touching the pool.  The router
+        supervisor sees ``_poisoned``, exports the in-flight requests, and
+        replays them on surviving replicas.  Chaos tests and the
+        ``replica_kill`` fault point call this; :meth:`revive` undoes it."""
+        self._poisoned = faults.FaultInjected(reason)
+        self.recorder.record(
+            "serve/engine_poisoned", error=reason, step=self._step_count,
+        )
+
+    def export_inflight(self) -> List[Request]:
+        """Snapshot every request this engine still owes an answer — running
+        lanes, the mid-prefill request, and the waiting queue — detached from
+        this engine's state and ready for :meth:`adopt` on a survivor.
+
+        Each RUNNING lane exports as ``prompt + generated-so-far`` via
+        ``Request.prefill_tokens`` (the preempt-and-replay machinery): replay
+        re-prefills the effective prompt and generation resumes exactly where
+        it stopped, token-exact under greedy.  Tokens already streamed are
+        never re-emitted.  Prefix-cache pins on THIS engine are released and
+        the per-engine prefill plan cleared — the adopting engine re-plans
+        against its own buckets and cache.  Device state is NOT touched (the
+        engine may be poisoned mid-window); :meth:`revive` handles teardown.
+        Returns requests in rid order — original FCFS submit order."""
+        out: List[Request] = []
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if req is not None and req.state is RequestState.RUNNING:
+                out.append(req)
+        if self._inflight is not None:
+            # a pre-freed lane's request left _slot_req when its final window
+            # dispatched but is still owed that window's tokens from the
+            # drain this engine will never run — it lives only on the handle
+            for s in self._inflight.prefreed:
+                req = self._inflight.reqs[s]
+                if (req is not None and req.state is RequestState.RUNNING
+                        and not any(req is r for r in out)):
+                    out.append(req)
+        if self.scheduler.prefilling is not None:
+            out.append(self.scheduler.prefilling)
+            self.scheduler.prefilling = None
+        out.extend(self.scheduler.queue)
+        self.scheduler.queue.clear()
+        for req in out:
+            if self.prefix_cache is not None and req.cache_nodes:
+                self.prefix_cache.release(req.cache_nodes)
+            req.cache_nodes = []
+            req.cached_chunks = 0
+            req.cache_chain_broken = False
+            req.chunks = ()
+            req.next_chunk = 0
+            req.slot = None
+            req.state = RequestState.QUEUED
+        out.sort(key=lambda r: r.rid)
+        self.recorder.record(
+            "serve/export_inflight", count=len(out), step=self._step_count,
+        )
+        return out
+
+    def adopt(self, request: Request) -> Request:
+        """Admit a request exported from a dead replica, at the FRONT of the
+        queue (it already waited its FCFS turn once).  The effective prompt
+        is ``prefill_tokens`` — greedy lanes replay token-exact; sampled
+        lanes resume on a re-seeded stream (the fresh rid folds into this
+        engine's base rng at install), distribution-correct but not
+        sample-exact.  Raises a non-retriable :class:`AdmissionError` when
+        the effective prompt cannot fit this engine's geometry; never
+        refused for queue depth — survivors absorb a dead peer's load."""
+        eff = len(request.prefill_tokens)
+        if eff > self.max_prompt_len:
+            raise AdmissionError(
+                f"replayed prompt+generated length {eff} > max_prompt_len "
+                f"{self.max_prompt_len}",
+                queue_depth=self.scheduler.queue_depth, retriable=False,
+            )
+        span = max(self.window, self.speculate_k + 1)
+        remaining = max(request.config.max_new_tokens - len(request.tokens), 1)
+        if eff + remaining + span > self.max_len:
+            raise AdmissionError(
+                f"replayed length {eff} + remaining {remaining} + span {span} "
+                f"exceeds slot capacity {self.max_len}",
+                queue_depth=self.scheduler.queue_depth, retriable=False,
+            )
+        padded = sum(b for b, _ in plan_chunks(eff, self.buckets))
+        cap = self.max_len if self.paged else self.max_prompt_len
+        if padded > cap:
+            raise AdmissionError(
+                f"replayed length {eff} pads to {padded} prefill tokens under "
+                f"buckets {self.buckets}, exceeding capacity {cap}",
+                queue_depth=self.scheduler.queue_depth, retriable=False,
+            )
+        old_rid = request.rid
+        request.rid = self._next_rid
+        self._next_rid += 1
+        self.scheduler.requeue(request)
+        self._bump("requests_submitted")
+        self._bump("requests_replayed")
+        if request.deadline_s is not None:
+            self._has_deadlines = True
+        self.recorder.record(
+            "serve/adopt", rid=request.rid, old_rid=old_rid,
+            effective_len=eff, generated=len(request.tokens),
+        )
+        return request
+
+    def revive(self) -> None:
+        """Tear a poisoned engine back down to a serviceable idle state.
+
+        The half-open circuit breaker's probe path: settle whatever the dead
+        step left in flight (a failed fetch is recorded, not fatal — the
+        window's pages still settle), retire every lane, drop the prefill
+        plan and any stragglers in the queue, flush the prefix cache (its
+        retained KV may be torn mid-write), and clear the poison.  The lane
+        device mirrors are dropped wholesale — the next dispatch re-uploads
+        them fresh rather than trusting vectors a dying window may have
+        corrupted."""
+        hd, self._inflight = self._inflight, None
+        if hd is not None:
+            try:
+                fetch(hd.toks)  # sync: proves the window's writes landed
+            except Exception as exc:
+                self.recorder.record(
+                    "serve/revive_fetch_failed", error=repr(exc),
+                )
+            if self.paged and hd.deferred_pages:
+                hd.settle(self.kv.allocator)
+        self._stale_handles.clear()
+        for s in range(self.num_slots):
+            if self._active[s] or self._slot_req[s] is not None:
+                self._retire_lane(s)
+        self.scheduler.prefilling = None
+        self._reserved_slot = None
+        for req in list(self.scheduler.queue):
+            # export_inflight normally emptied this; anything left has no
+            # owner to stream to — drop it cleanly with its pins
+            self.scheduler.cancel(req.rid)
+        if self.prefix_cache is not None:
+            self.scheduler.drop_cache_pins()
+            self.prefix_cache.flush()
+        self._lane_device = None
+        self._mask_stale = False
+        self._t_pipeline_empty = None
+        self._poisoned = None
+        self.admission_paused = False
+        self.recorder.record("serve/revive", step=self._step_count)
+
+    def _shed_blown_deadlines(self) -> None:
+        """Per-step deadline sweep (only runs while a deadline is live):
+        cancel running lanes and queued requests past their ``deadline_s``,
+        marking ``deadline_exceeded`` so the API layer answers 504."""
+        now = time.perf_counter()
+        any_live = False
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if req is None or req.deadline_s is None or not self._active[s]:
+                continue
+            elapsed = now - req.submit_time
+            if elapsed <= req.deadline_s:
+                any_live = True
+                continue
+            self._retire_lane(s)
+            req.deadline_exceeded = True
+            req.state = RequestState.CANCELLED
+            req.finish_step = self._step_count
+            self._bump("deadline_shed")
+            self.recorder.record(
+                "serve/deadline_shed", where="running", rid=req.rid, slot=s,
+                deadline_s=req.deadline_s, elapsed_s=elapsed,
+                tokens=len(req.tokens),
+            )
+        for req in list(self.scheduler.queue):
+            if req.deadline_s is None:
+                continue
+            elapsed = now - req.submit_time
+            if elapsed <= req.deadline_s:
+                any_live = True
+                continue
+            self.scheduler.cancel(req.rid)
+            req.deadline_exceeded = True
+            self._bump("deadline_shed")
+            self.recorder.record(
+                "serve/deadline_shed", where="queued", rid=req.rid,
+                deadline_s=req.deadline_s, elapsed_s=elapsed,
+            )
+        pre = self.scheduler.prefilling
+        if pre is not None and pre.deadline_s is not None:
+            any_live = True  # finishes its chunks; the running sweep catches it
+        self._has_deadlines = any_live
 
     # -------------------------------------------------------------- admission
     def _next_free_slot(self) -> Optional[int]:
@@ -1319,6 +1556,13 @@ class ServingEngine:
     def _finish_request(self, slot: int, req: Request) -> None:
         req.state = RequestState.DONE
         req.finish_step = self._step_count
+        # end-to-end service time EMA: the per-queued-request cost behind
+        # submit()'s deadline feasibility estimate
+        dur = max(time.perf_counter() - req.submit_time, 0.0)
+        self._service_ema = (
+            dur if self._service_ema == 0.0
+            else 0.8 * self._service_ema + 0.2 * dur
+        )
         self._bump("requests_completed")
         self.recorder.record(
             "serve/finish", rid=req.rid, slot=slot, step=self._step_count,
@@ -1385,6 +1629,11 @@ class ServingEngine:
         n_occupied = int(self._active.sum())
         self.peak_active_lanes = max(self.peak_active_lanes, n_occupied)
         self._occupancy_gauge.set(n_occupied / self.num_slots)
+        if faults.ACTIVE is not None and faults.ACTIVE.fire("decode_dispatch"):
+            raise faults.FaultInjected(
+                f"injected decode-window dispatch failure "
+                f"(step {self._step_count}, {n_occupied} lanes)"
+            )
         drafts = self._propose_drafts() if self.speculate_k else None
         if drafts is not None:
             hd = self._verify_cycle(*drafts, n_occupied=n_occupied)
@@ -1420,6 +1669,27 @@ class ServingEngine:
         re-installed since dispatch fails the ownership check in ``_emit``
         and its tokens are dropped — exactly what the sync loop would never
         have produced)."""
+        try:
+            self._drain_impl(hd)
+        except BaseException:
+            # a failed drain poisons this engine (step()'s wrapper) with the
+            # handle already detached from ``_inflight`` — a pre-freed lane's
+            # request lives ONLY on that handle, so requeue it here or
+            # export_inflight never sees it and its caller waits forever
+            for s in hd.prefreed:
+                req = hd.reqs[s]
+                if req is not None and req.state is RequestState.RUNNING:
+                    self.scheduler.requeue(req)
+            raise
+
+    def _drain_impl(self, hd: Readback) -> None:
+        if faults.ACTIVE is not None:
+            if faults.ACTIVE.fire("fetch_slow"):
+                time.sleep(faults.ACTIVE.slow_ms / 1e3)  # stalled interconnect
+            if faults.ACTIVE.fire("fetch_fail"):
+                raise faults.FaultInjected(
+                    f"injected readback failure (step {self._step_count})"
+                )
         t0 = time.perf_counter()
         with self.tracer.span("serve/readback", kind=hd.kind,
                               occupied=hd.n_occupied):
@@ -1722,7 +1992,39 @@ class ServingEngine:
     # ------------------------------------------------------------------ drive
     def step(self) -> None:
         """One engine iteration: budgeted chunked-prefill admission, then one
-        masked decode window over the pool."""
+        masked decode window over the pool.
+
+        Fault containment: the first exception to escape the step body parks
+        in ``_poisoned`` and re-raises — this engine never half-runs again
+        until :meth:`revive`.  The router supervisor treats a poisoned
+        replica as dead, exports its in-flight requests, and replays them on
+        survivors (:meth:`export_inflight` / :meth:`adopt`)."""
+        if self._poisoned is not None:
+            raise self._poisoned
+        try:
+            self._step_impl()
+        except Exception as exc:
+            self._poisoned = exc
+            self.recorder.record(
+                "serve/engine_poisoned", error=repr(exc), step=self._step_count,
+            )
+            raise
+
+    def _step_impl(self) -> None:
+        if self._has_deadlines:
+            self._shed_blown_deadlines()
+        if (faults.ACTIVE is not None and self.paged and self._active.any()
+                and faults.ACTIVE.fire("page_exhaustion")):
+            # stand-in for the pool running dry: run the reclaim ladder's
+            # last resort (preempt the youngest lane for front-of-queue
+            # replay) exactly as _ensure_decode_capacity would under pressure.
+            # Drain first, as the ladder's step 2 does: with the prior window
+            # still in flight the victim could re-install into its old slot
+            # before the drain, and the stale window's tokens would pass the
+            # ownership check and land twice.
+            if self._inflight is not None:
+                self._drain_inflight()
+            self._preempt()
         queue_depth = self.scheduler.queue_depth
         self._queue_gauge.set(queue_depth)
         self._prefree_exhausted()

@@ -49,15 +49,17 @@ event per submit carrying the chosen replica and its affinity score.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..telemetry import MetricsRegistry, get_flight_recorder, get_registry
+from . import faults
 from .engine import ServingEngine
 from .errors import AdmissionError
 from .pool import plan_chunks
-from .scheduler import Request
+from .scheduler import Request, RequestState
 
 _POLICIES = ("affinity", "round_robin")
 
@@ -83,6 +85,8 @@ class ReplicaRouter:
         engines: Sequence[ServingEngine],
         policy: str = "affinity",
         registry: Optional[MetricsRegistry] = None,
+        breaker_base_s: float = 0.5,
+        breaker_max_s: float = 30.0,
     ):
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
@@ -109,6 +113,19 @@ class ReplicaRouter:
             "serve/router_affinity_hit_rate",
             help="fraction of routed requests whose chosen replica already "
                  "held a matching prefix in its radix tree",
+        )
+        # half-open circuit breaker over ejected replicas: replica_id ->
+        # {"engine", "failures", "open_until"}.  While open, no traffic; once
+        # ``open_until`` passes, one probe (revive + a step) either re-admits
+        # the replica or doubles the backoff.
+        self.breaker_base_s = float(breaker_base_s)
+        self.breaker_max_s = float(breaker_max_s)
+        self._breaker: Dict[int, dict] = {}
+        self._ejections = 0
+        self._ejections_counter = self.metrics.counter(
+            "serve/replica_ejections_total",
+            help="replicas ejected by the router supervisor after a poisoned "
+                 "step (their in-flight requests replay on survivors)",
         )
 
     # ------------------------------------------------------------- placement
@@ -340,10 +357,109 @@ class ReplicaRouter:
             out[e.weights_version] = out.get(e.weights_version, 0) + 1
         return out
 
+    # -------------------------------------------------------- fault recovery
+    def _eject_and_replay(self, engine: ServingEngine, exc: BaseException) -> None:
+        """Remove a dead replica and replay everything it owed on survivors.
+
+        The replica's in-flight requests (:meth:`ServingEngine.
+        export_inflight` — running lanes as prompt + generated-so-far,
+        mid-prefill, queued) are adopted by surviving replicas at the FRONT
+        of their queues, least-loaded first: greedy lanes resume token-exact,
+        sampled lanes re-seeded.  A request no survivor can fit (geometry
+        refusal) is CANCELLED — its stream closes rather than hangs.  The
+        dead engine parks behind the half-open circuit breaker; once the
+        backoff expires, :meth:`_probe_breaker` revives and re-admits it."""
+        if engine not in self.engines:
+            return
+        i = self.engines.index(engine)
+        replica_id = self._ids[i]
+        exported = engine.export_inflight()
+        del self.engines[i]
+        del self._ids[i]
+        self._draining.discard(replica_id)
+        self._replicas_gauge.set(float(len(self.engines)))
+        self._ejections += 1
+        self._ejections_counter.inc()
+        self.recorder.record(
+            "serve/failover", replica_id=replica_id, error=repr(exc),
+            inflight=len(exported), replicas_left=len(self.engines),
+        )
+        self._breaker[replica_id] = {
+            "engine": engine,
+            "failures": 0,
+            "open_until": time.monotonic() + self.breaker_base_s,
+        }
+        # newest first: each appendleft lands in front of the previous one,
+        # so per-survivor queue order ends up oldest-rid-first (FCFS intact)
+        for req in reversed(exported):
+            self._replay_one(req)
+
+    def _replay_one(self, req: Request) -> None:
+        survivors = sorted(
+            range(len(self.engines)), key=lambda i: self._load(self.engines[i])
+        )
+        last_err: Optional[Exception] = None
+        for i in survivors:
+            try:
+                self.engines[i].adopt(req)
+            except AdmissionError as exc:
+                last_err = exc
+                continue
+            req.replica = i
+            req.replica_id = self._ids[i]
+            self.recorder.record(
+                "serve/replay", rid=req.rid, replica=i,
+                generated=len(req.tokens),
+            )
+            return
+        req.state = RequestState.CANCELLED
+        req.deadline_exceeded = False
+        self.recorder.record(
+            "serve/replay_failed", rid=req.rid,
+            error=repr(last_err) if last_err is not None else "no survivors",
+        )
+
+    def _probe_breaker(self) -> None:
+        """Half-open probe: for every ejected replica whose backoff expired,
+        try ``revive()`` + one step.  Success re-admits it as a fresh replica
+        (new stable id); failure doubles the backoff up to ``breaker_max_s``."""
+        if not self._breaker:
+            return
+        now = time.monotonic()
+        for replica_id in [r for r, b in self._breaker.items()
+                           if now >= b["open_until"]]:
+            entry = self._breaker[replica_id]
+            engine = entry["engine"]
+            try:
+                engine.revive()
+                engine.step()  # one idle probe step proves it can run
+            except Exception as exc:
+                entry["failures"] += 1
+                entry["open_until"] = now + min(
+                    self.breaker_max_s,
+                    self.breaker_base_s * 2 ** entry["failures"],
+                )
+                self.recorder.record(
+                    "serve/breaker_open", replica_id=replica_id,
+                    failures=entry["failures"], error=repr(exc),
+                )
+                continue
+            del self._breaker[replica_id]
+            new_id = self.add_replica(engine)
+            self.recorder.record(
+                "serve/breaker_close", replica_id=replica_id, new_id=new_id,
+                failures=entry["failures"],
+            )
+
     # ----------------------------------------------------------------- drive
     @property
     def has_work(self) -> bool:
-        return any(e.has_work for e in self.engines)
+        # a due breaker probe is work: the drive loop must keep stepping so
+        # an ejected replica gets its re-admission attempt even when idle
+        if any(e.has_work for e in self.engines):
+            return True
+        now = time.monotonic()
+        return any(now >= b["open_until"] for b in self._breaker.values())
 
     def step(self) -> None:
         """One iteration of every replica that has work (round-robin drive —
@@ -353,11 +469,31 @@ class ReplicaRouter:
         flight on replica A, the drive moves on to dispatch replica B's
         window while A's device computes, so even the single-threaded drive
         overlaps replicas; ``has_work`` holds until every replica's pipeline
-        has drained (an in-flight window counts as work)."""
-        for e in list(self.engines):
-            if e.has_work:
-                e.step()
+        has drained (an in-flight window counts as work).
+
+        Supervision rides the same loop: a replica whose step raises — or
+        that arrives already poisoned (:meth:`ServingEngine.kill`) — is
+        ejected and its in-flight requests replay on survivors; ejected
+        replicas re-admit through the half-open circuit breaker."""
+        if (faults.ACTIVE is not None and len(self.engines) > 1
+                and faults.ACTIVE.fire("replica_kill")):
+            # kill the busiest replica — the worst case for replay
+            victim = max(self.engines, key=lambda e: int(e._active.sum()))
+            victim.kill("injected replica kill")
+        for engine in list(self.engines):
+            if engine not in self.engines:
+                continue  # ejected earlier this very step
+            if engine._poisoned is not None:
+                self._eject_and_replay(engine, engine._poisoned)
+                continue
+            if not engine.has_work:
+                continue
+            try:
+                engine.step()
+            except Exception as exc:
+                self._eject_and_replay(engine, exc)
         self._reap_drained()
+        self._probe_breaker()
 
     def run(self, max_steps: Optional[int] = None) -> None:
         steps = 0
@@ -404,6 +540,7 @@ class ReplicaRouter:
     def health(self) -> dict:
         """One snapshot a front door can poll: per-replica queue/occupancy
         plus the router's routing counters."""
+        now = time.monotonic()
         return {
             "replicas": len(self.engines),
             "policy": self.policy,
@@ -411,6 +548,15 @@ class ReplicaRouter:
             "affinity_hit_rate": (
                 self._affinity_hits / self._routed if self._routed else 0.0
             ),
+            "ejections": self._ejections,
+            "breaker": [
+                {
+                    "replica_id": r,
+                    "failures": b["failures"],
+                    "retry_in_s": max(b["open_until"] - now, 0.0),
+                }
+                for r, b in self._breaker.items()
+            ],
             "versions": self.versions(),
             "per_replica": [
                 {

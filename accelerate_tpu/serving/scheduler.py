@@ -86,6 +86,12 @@ class Request:
     # ``router.engines``, which shifts when an earlier replica detaches),
     # this id survives elastic add/drain — cancel resolves through it first
     replica_id: Optional[int] = None
+    # SLO deadline in seconds from submit (None = no deadline).  Admission
+    # sheds when the queue-depth estimate says it is unmeetable; the engine's
+    # deadline sweep cancels a running lane that blows it and sets
+    # ``deadline_exceeded`` so the API layer can answer 504 instead of 500
+    deadline_s: Optional[float] = None
+    deadline_exceeded: bool = False
 
     @property
     def done(self) -> bool:

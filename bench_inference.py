@@ -1418,6 +1418,358 @@ def _http_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _chaos_ab_bench(args, model, cfg, params, preset):
+    """Chaos A/B: replica failure, seeded fault soak, zero-cost-when-off.
+
+    Three arms over one greedy workload, each a HARD check (SystemExit):
+
+    * kill — two paged replicas behind the front door; the busy one is
+      poisoned mid-decode (``ServingEngine.kill``, the ``replica_kill``
+      stand-in for a device loss).  Every concurrent request must still
+      return HTTP 200 with tokens identical to the pre-chaos in-process
+      reference (in-flight lanes replay on the survivor from prompt +
+      generated prefix; greedy replay is token-exact), the router must
+      record >= 1 ejection, and the dead replica must re-admit through the
+      half-open circuit breaker before the arm ends;
+    * soak — a seeded probabilistic fault mix (stalled fetches, injected
+      page exhaustion, a one-shot fetch failure and a one-shot dispatch
+      error) runs under a 2x concurrent burst: >= 99% of requests must
+      complete HTTP 200 token-identical, and ZERO ``serve/driver_error``
+      flight events may land — infrastructure faults never crash the
+      FrontDoor driver thread;
+    * off — with faults disabled the hot path must cost nothing: the
+      disabled serve must be within 1% of an armed-but-inert run
+      (interleaved best-of-N mins damp CPU noise), and the compile counts
+      of every watchdog on both replicas must be IDENTICAL to the
+      pre-chaos snapshot — kill, replay, preemption and the fault checks
+      compiled zero new executables.
+
+    ``value`` is over-the-wire tokens/s during the kill arm;
+    ``vs_baseline`` divides by the in-process ``eng.serve`` tokens/s on the
+    same workload — what surviving a replica loss costs end to end.
+    """
+    import http.client
+    import threading
+
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ReplicaRouter, ServingEngine, faults
+    from accelerate_tpu.serving.api import ApiServer, FrontDoor
+    from accelerate_tpu.telemetry import MetricsRegistry, get_flight_recorder
+
+    params = jax.device_put(params)
+    slots = args.batch
+    window = args.decode_window
+    page = 4
+    # page-aligned geometry: paged replicas so the injected page_exhaustion
+    # point exercises the real preemption ladder
+    mp = -(-max(8, min(args.seq, cfg.max_seq_len) // 4) // page) * page
+    buckets = tuple(sorted({max(8, -(-(mp // 2) // page) * page), mp}))
+    new_tokens = 4 * window
+    n = args.requests
+    max_len = min(cfg.max_seq_len, -(-(mp + new_tokens + window) // page) * page)
+    # generous pool: exhaustion in this bench is INJECTED, a tight pool
+    # would add real (but still deterministic) preemptions on top
+    num_pages = 2 * slots * (max_len // page) + 1
+    # the soak arm replays one replica's whole in-flight set plus a 2x burst
+    # onto the survivor; the queue must absorb all of it without 429s
+    mq = max(8, slots, 4 * n)
+
+    r = np.random.default_rng(args.serve_seed)
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, n)), 4, mp
+    ).astype(int)
+    prompts = [r.integers(1, cfg.vocab_size, (int(k),)).astype(np.int32)
+               for k in prompt_lens]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    useful_tokens = n * new_tokens
+
+    registry = MetricsRegistry()
+
+    def build():
+        return ServingEngine(
+            model, params, num_slots=slots, max_len=max_len,
+            prefill_buckets=buckets, decode_window=window,
+            registry=registry, max_queue=mq, paged=True, page_size=page,
+            num_pages=num_pages, prefix_cache_mb=0,
+        )
+
+    e1, e2 = build(), build()
+    warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32)
+            for b in buckets]
+    for e in (e1, e2):
+        e.serve(warm, GenerationConfig(max_new_tokens=window))
+
+    # in-process reference + baseline timing (identical weights on both
+    # replicas: greedy tokens are replica-independent)
+    t0 = time.perf_counter()
+    reqs = e1.serve(prompts, [gen] * n)
+    dt_inproc = time.perf_counter() - t0
+    ref = [[int(t) for t in q.tokens] for q in reqs]
+
+    def compile_counts():
+        return {f"r{k}/{wd.name}": wd.compile_count
+                for k, e in enumerate((e1, e2))
+                for wd in [e._decode, e._lane_install, e._copy_page,
+                           *e._prefill.values()]
+                if wd is not None}
+
+    compiles_before = compile_counts()
+    flight = get_flight_recorder()
+
+    def driver_errors():
+        return sum(1 for ev in flight.tail()
+                   if ev.get("kind") == "serve/driver_error")
+
+    derr_before = driver_errors()
+
+    router = ReplicaRouter([e1, e2], registry=registry, breaker_base_s=0.05)
+    fd = FrontDoor(router, model_name=f"bench-{preset}").start()
+    srv = ApiServer(fd, registry=registry)
+    host, port = srv.host, srv.port
+
+    def post_json(path, payload, timeout=600.0):
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("POST", path, json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            return resp.status, dict(resp.getheaders()), json.loads(raw)
+        finally:
+            conn.close()
+
+    def completion(i, max_tokens=new_tokens):
+        return post_json("/v1/completions", {
+            "prompt": [int(t) for t in prompts[i]],
+            "max_tokens": max_tokens, "temperature": 0,
+        })
+
+    def fanout(fn, work):
+        out = [None] * len(work)
+
+        def run(k, item):
+            try:
+                out[k] = fn(*item)
+            except Exception as exc:  # surfaced as a hard bench failure
+                out[k] = exc
+
+        threads = [threading.Thread(target=run, args=(k, item), daemon=True)
+                   for k, item in enumerate(work)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errs = [o for o in out if isinstance(o, Exception)]
+        if errs:
+            raise SystemExit(f"--chaos-ab: client transport error: {errs[0]!r}")
+        return out
+
+    # ---- arm 1: replica kill mid-generation — zero failed, token identity
+    killed = {}
+
+    def assassin():
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            for name, e in (("r1", e2), ("r0", e1)):
+                if e in router.engines and e._active.any():
+                    e.kill("chaos-ab: injected mid-decode device loss")
+                    killed["replica"] = name
+                    return
+            time.sleep(0.002)
+
+    kt = threading.Thread(target=assassin, daemon=True)
+    kt.start()
+    t0 = time.perf_counter()
+    responses = fanout(completion, [(i,) for i in range(n)])
+    dt_chaos = time.perf_counter() - t0
+    kt.join()
+    if "replica" not in killed:
+        raise SystemExit("--chaos-ab kill: no replica ever had in-flight "
+                         "lanes to kill — the workload never got going")
+    for i, (status, _, body) in enumerate(responses):
+        if status != 200:
+            raise SystemExit(f"--chaos-ab kill: request {i} failed with HTTP "
+                             f"{status} after the replica kill: {body}")
+        got = body["choices"][0]["token_ids"]
+        if got != ref[i]:
+            raise SystemExit(
+                f"--chaos-ab kill: request {i} returned {got[:8]}... != "
+                f"in-process reference {ref[i][:8]}... — replay after the "
+                "kill was not token-identical"
+            )
+    snap = registry.snapshot()
+    ejections = int(snap.get("serve/replica_ejections_total", 0))
+    if ejections < 1:
+        raise SystemExit("--chaos-ab kill: a replica was poisoned but "
+                         "serve/replica_ejections_total is 0 — the router "
+                         "supervisor never ejected it")
+    replays = sum(e.stats["requests_replayed"] for e in (e1, e2))
+    t_end = time.monotonic() + 30.0
+    while time.monotonic() < t_end and len(router.engines) < 2:
+        time.sleep(0.01)
+    if len(router.engines) < 2:
+        raise SystemExit("--chaos-ab kill: the ejected replica never "
+                         "re-admitted through the half-open circuit breaker")
+
+    # ---- arm 2: seeded fault-mix soak — >= 99% completion, driver survives
+    soak_n = 2 * n
+    soak_plan = (f"seed={args.serve_seed},fetch_slow=0.05,slow_ms=5,"
+                 f"page_exhaustion=0.01,fetch_fail@7,decode_dispatch@29")
+    faults.install(soak_plan, registry=registry)
+    try:
+        soak = fanout(completion, [(i % n,) for i in range(soak_n)])
+    finally:
+        faults.clear()
+    completed = sum(
+        1 for k, (status, _, body) in enumerate(soak)
+        if status == 200 and body["choices"][0]["token_ids"] == ref[k % n]
+    )
+    for k, (status, _, body) in enumerate(soak):
+        if status == 200 and body["choices"][0]["token_ids"] != ref[k % n]:
+            raise SystemExit(
+                f"--chaos-ab soak: request {k} returned HTTP 200 with "
+                "tokens diverging from the reference — a fault corrupted a "
+                "surviving lane"
+            )
+    rate = completed / soak_n
+    if rate < 0.99:
+        bad = [(k, s) for k, (s, _, _) in enumerate(soak) if s != 200]
+        raise SystemExit(
+            f"--chaos-ab soak: {completed}/{soak_n} completed "
+            f"({rate:.1%}) under the fault mix; gate is >= 99%. "
+            f"non-200s: {bad[:5]}"
+        )
+    derr = driver_errors() - derr_before
+    if derr != 0:
+        raise SystemExit(
+            f"--chaos-ab soak: {derr} serve/driver_error flight event(s) — "
+            "an injected fault escaped containment and crashed the "
+            "FrontDoor driver thread"
+        )
+    faults_fired = int(registry.snapshot().get(
+        "serve/faults_injected_total", 0))
+    if faults_fired < 1:
+        raise SystemExit("--chaos-ab soak: the fault plan never fired — the "
+                         "soak arm tested nothing")
+    t_end = time.monotonic() + 30.0
+    while time.monotonic() < t_end and len(router.engines) < 2:
+        time.sleep(0.01)
+
+    srv.stop()
+    fd.stop()
+
+    # ---- arm 3: faults disabled — zero hot-path cost, zero new executables
+    # interleave disabled and armed-but-inert (one-shot parked far beyond
+    # the workload: every check consults the injector, none fire) runs,
+    # alternating which goes first, and gate on the MEDIAN of per-rep
+    # paired ratios: back-to-back pairs cancel machine drift, alternation
+    # cancels ordering bias, the median kills outlier pairs — min-of-N on
+    # its own still carries multi-percent jitter on shared hosts
+    reps = 8
+    rounds = 3  # serve() calls per timed sample — lifts each sample well
+    # above scheduler/timer jitter so the 1% gate measures the hot path
+    t_off, t_armed = [], []
+    inert = f"seed={args.serve_seed},decode_dispatch@1000000000"
+    faults.clear()
+    e1.serve(prompts, [gen] * n)  # discarded warm-up
+
+    def _timed_off():
+        faults.clear()
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            e1.serve(prompts, [gen] * n)
+        t_off.append(time.perf_counter() - t0)
+
+    def _timed_armed():
+        faults.install(inert, registry=registry)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                e1.serve(prompts, [gen] * n)
+            t_armed.append(time.perf_counter() - t0)
+        finally:
+            faults.clear()
+
+    for k in range(reps):
+        first, second = ((_timed_off, _timed_armed) if k % 2 == 0
+                         else (_timed_armed, _timed_off))
+        first()
+        second()
+    best_off, best_armed = min(t_off), min(t_armed)
+    ratios = sorted(o / a for o, a in zip(t_off, t_armed))
+    mid = len(ratios) // 2
+    med_ratio = (ratios[mid] if len(ratios) % 2
+                 else 0.5 * (ratios[mid - 1] + ratios[mid]))
+    if med_ratio > 1.01:
+        raise SystemExit(
+            f"--chaos-ab off: faults-disabled serve is {med_ratio - 1.0:+.1%} "
+            f"vs the armed-but-inert run (median of {reps} paired ratios; "
+            f"mins {best_off:.3f}s vs {best_armed:.3f}s) — the disabled "
+            "path is doing work; gate is <= 1%"
+        )
+    compiles_after = compile_counts()
+    if compiles_after != compiles_before:
+        diff = {k: (compiles_before.get(k), v)
+                for k, v in compiles_after.items()
+                if compiles_before.get(k) != v}
+        raise SystemExit(f"--chaos-ab off: chaos compiled new executables "
+                         f"(name: before -> after): {diff}")
+
+    chaos_tps = useful_tokens / dt_chaos
+    snap = registry.snapshot()
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "num_slots": slots,
+        "decode_window": window,
+        "new_tokens_per_request": new_tokens,
+        "useful_tokens": useful_tokens,
+        "chaos_wall_s": round(dt_chaos, 3),
+        "inproc_wall_s": round(dt_inproc, 3),
+        "inproc_tokens_per_s": round(useful_tokens / dt_inproc, 2),
+        "kill": {
+            "killed_replica": killed["replica"],
+            "failed": 0,                       # hard-checked above
+            "outputs_token_identical": True,   # hard-checked above
+            "ejections": ejections,
+            "requests_replayed": replays,
+            "breaker_readmitted": True,        # hard-checked above
+        },
+        "soak": {
+            "plan": soak_plan,
+            "requests": soak_n,
+            "completed": completed,
+            "completion_rate": round(rate, 4),
+            "faults_injected": faults_fired,
+            "driver_errors": 0,                # hard-checked above
+        },
+        "off": {
+            "repeats": reps,
+            "disabled_best_s": round(best_off, 4),
+            "armed_inert_best_s": round(best_armed, 4),
+            "disabled_vs_armed": round(best_off / best_armed, 4),
+            "disabled_vs_armed_median": round(med_ratio, 4),
+            "new_executables": 0,              # hard-checked above
+        },
+        "replica_ejections_total": int(
+            snap.get("serve/replica_ejections_total", 0)),
+        "requests_replayed_total": sum(
+            e.stats["requests_replayed"] for e in (e1, e2)),
+        "faults_injected_total": int(
+            snap.get("serve/faults_injected_total", 0)),
+        "deadline_shed_total": sum(
+            e.stats["deadline_shed"] for e in (e1, e2)),
+    }
+    return {
+        "metric": "chaos_serving_tokens_per_sec",
+        "value": round(chaos_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(chaos_tps / (useful_tokens / dt_inproc), 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -1441,14 +1793,17 @@ def _serve_bench(args, model, cfg, params, preset):
             bool(getattr(args, "tp_ab", False)),
             bool(getattr(args, "async_ab", False)),
             bool(getattr(args, "http_ab", False)),
+            bool(getattr(args, "chaos_ab", False)),
             bool(args.shared_prefix)]) > 1:
         raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab, "
-                         "--http-ab and --shared-prefix are separate serve "
-                         "workloads; pick one")
+                         "--http-ab, --chaos-ab and --shared-prefix are "
+                         "separate serve workloads; pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "http_ab", False):
         return _http_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "chaos_ab", False):
+        return _chaos_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "kernel_ab", False):
         return _kernel_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "tp_ab", False):
@@ -1668,6 +2023,14 @@ def main():
                              "with zero engine errors, and a mid-bench weight "
                              "hot-swap with zero failed or mixed-weight "
                              "in-flight requests (all hard checks)")
+    parser.add_argument("--chaos-ab", dest="chaos_ab", action="store_true",
+                        help="--task serve: chaos the serving stack — kill a "
+                             "replica mid-generation (zero failed requests, "
+                             "token-identical replay on the survivor), soak "
+                             "a seeded fault mix (>=99%% completion, zero "
+                             "driver crashes), then prove faults-off costs "
+                             "nothing (<=1%% A/B, zero new executables; all "
+                             "hard checks)")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",
                         help="--kernel-ab: quantized KV page format for the "

@@ -1,0 +1,31 @@
+"""Fixture: broad excepts that swallow — every shape the rule must flag."""
+
+
+def bare_except_pass(engine):
+    try:
+        engine.step()
+    except:  # violation: bare except, nothing routed
+        pass
+
+
+def broad_except_return(router):
+    try:
+        return router.submit([1, 2, 3])
+    except Exception:  # violation: swallows and returns a default
+        return None
+
+
+def tuple_with_broad(stream, log):
+    try:
+        stream.push(1)
+    except (ValueError, Exception) as exc:  # violation: tuple hides Exception
+        log(exc)
+
+
+def base_exception_default(engine):
+    result = 1
+    try:
+        result = engine.step()
+    except BaseException:  # violation: assignment target is not an error slot
+        result = 0
+    return result

@@ -1,0 +1,51 @@
+"""Fixture: broad excepts that handle their error — none may be flagged."""
+
+
+def typed_except(scheduler):
+    try:
+        scheduler.submit(None)
+    except ValueError:  # typed: not the rule's business
+        return False
+
+
+def reraises(engine, recorder):
+    try:
+        engine.step()
+    except Exception as exc:
+        recorder.record("serve/engine_poisoned", error=repr(exc))
+        raise
+
+
+def raise_from(router):
+    try:
+        router.step()
+    except Exception as exc:
+        raise RuntimeError("step failed") from exc
+
+
+def records_to_flight_recorder(engine, recorder):
+    try:
+        engine.step()
+    except Exception as exc:
+        recorder.record("serve/driver_error", error=repr(exc))
+
+
+def stores_for_waiting_thread(ticket, fn):
+    try:
+        ticket.result = fn()
+    except BaseException as exc:
+        ticket.error = exc
+
+
+def closes_the_stream(stream, req):
+    try:
+        req.emit(1)
+    except Exception as exc:
+        stream.close(req.tokens, req.state, error=exc)
+
+
+def cancels_the_lane(frontdoor, rid):
+    try:
+        frontdoor.submit(rid)
+    except Exception:
+        frontdoor.cancel(rid)

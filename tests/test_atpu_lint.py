@@ -38,6 +38,7 @@ EXPECTED_RULE_IDS = {
     "use-after-donate",
     "implicit-host-sync",
     "jit-signature-drift",
+    "swallowed-exception",
 }
 
 
@@ -74,6 +75,8 @@ def test_registry_is_complete():
          "implicit_host_sync_clean.py"),
         ("jit-signature-drift", "jit_signature_drift_bad.py", 5,
          "jit_signature_drift_clean.py"),
+        ("swallowed-exception", "swallowed_exception_bad.py", 4,
+         "swallowed_exception_clean.py"),
     ],
 )
 def test_rule_golden(rule_id, bad, n_bad, clean):

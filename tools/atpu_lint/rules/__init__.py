@@ -17,6 +17,7 @@ from .method_lru_cache import MethodLruCacheRule
 from .pallas_interpret import PallasInterpretRule
 from .reference_citations import ReferenceCitationsRule
 from .sharding_annotations import ShardingAnnotationsRule
+from .swallowed_exception import SwallowedExceptionRule
 from .use_after_donate import UseAfterDonateRule
 
 #: declaration order is display order in --list-rules and the docs
@@ -32,6 +33,7 @@ ALL_RULES: List[Type[Rule]] = [
     UseAfterDonateRule,
     ImplicitHostSyncRule,
     JitSignatureDriftRule,
+    SwallowedExceptionRule,
 ]
 
 RULES_BY_ID: Dict[str, Type[Rule]] = {cls.id: cls for cls in ALL_RULES}

@@ -1,0 +1,105 @@
+"""Rule ``swallowed-exception``: broad excepts in ``serving/`` must re-raise
+or route the error somewhere an operator can see it.
+
+The serving stack's fault-tolerance contract (ISSUE 13) is that failures are
+*schedulable events*: a poisoned step reaches the router supervisor, a dead
+stream closes with its error, a refused ticket propagates to the handler
+thread.  A ``except Exception: pass`` (or a bare ``except``) anywhere on
+that path silently converts a recoverable failure into a hung request — the
+exact bug class chaos testing exists to catch, and one that stays invisible
+in single-threaded tests.
+
+Flagged inside ``accelerate_tpu/serving/``: any handler catching
+``Exception`` / ``BaseException`` (bare ``except`` included, alone or in a
+tuple) whose body neither
+
+* re-raises (``raise`` anywhere in the handler), nor
+* routes the error to a sanctioned sink — the flight recorder
+  (``.record(...)`` / ``logger.exception``), the stream-failure path
+  (``stream.close``, ``_fail_outstanding``), the HTTP error surface
+  (``_safe_error`` / ``_admission_refused`` / ``_send`` / ``error_body``),
+  or recovery (``cancel`` / ``_eject_and_replay``), nor
+* stores it for a waiting thread (assignment to a name/attribute containing
+  ``error`` — the ticket rendezvous pattern ``t.error = exc``).
+
+Escape hatch: ``# noqa: swallowed-exception`` with a justifying comment on
+the ``except`` line (e.g. best-effort writes to a socket that is already
+gone).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Diagnostic, Rule
+from ._ast_utils import dotted
+
+#: exception names whose broad catch demands a re-raise or a sink
+BROAD_NAMES = ("Exception", "BaseException")
+#: terminal call names that count as routing the error somewhere visible
+SANCTIONED_SINKS = (
+    "record", "exception", "_fail_outstanding", "close", "_safe_error",
+    "_admission_refused", "_send", "error_body", "cancel",
+    "_eject_and_replay",
+)
+
+
+def _is_broad(expr) -> bool:
+    """Does this ``except`` type expression catch Exception/BaseException?"""
+    if expr is None:
+        return True  # bare except
+    if isinstance(expr, ast.Tuple):
+        return any(_is_broad(e) for e in expr.elts)
+    name = dotted(expr)
+    return name is not None and name.rsplit(".", 1)[-1] in BROAD_NAMES
+
+
+def _handled(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, routes to a sanctioned sink, or
+    stores the error for another thread."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is not None and name.rsplit(".", 1)[-1] in SANCTIONED_SINKS:
+                return True
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                label = t.attr if isinstance(t, ast.Attribute) else (
+                    t.id if isinstance(t, ast.Name) else ""
+                )
+                if "error" in label.lower():
+                    return True
+    return False
+
+
+class SwallowedExceptionRule(Rule):
+    id = "swallowed-exception"
+    summary = ("broad excepts in serving/ must re-raise or route the error "
+               "to the flight recorder / stream-failure path")
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith("accelerate_tpu/serving/")
+
+    def visit(self, tree, src, ctx) -> List[Diagnostic]:
+        out = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if _handled(node):
+                continue
+            caught = "bare except" if node.type is None else (
+                f"except {ast.unparse(node.type)}"
+            )
+            out.setdefault(node.lineno, Diagnostic(
+                ctx.rel, node.lineno, self.id,
+                f"{caught} swallows the error — re-raise, record it "
+                "(flight recorder / logger.exception), close the stream "
+                "with it, or justify with '# noqa: swallowed-exception'",
+            ))
+        return [out[k] for k in sorted(out)]
