@@ -51,6 +51,7 @@ bench-serve:
 	python bench_inference.py --task serve --shared-prefix 16
 	python bench_inference.py --task serve --paged-ab
 	python bench_inference.py --task serve --kernel-ab
+	python bench_inference.py --task serve --prefill-ab
 	python bench_inference.py --task serve --tp-ab
 	python bench_inference.py --task serve --async-ab
 	python bench_inference.py --task serve --http-ab

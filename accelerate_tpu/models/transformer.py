@@ -181,8 +181,11 @@ class TransformerConfig:
     # Attention program for PagedKVCache forwards (the serving engine's
     # in-model paged windows): "xla" is the live-masked-gather reference —
     # bitwise identical to the contiguous slab; "pallas" the in-place paged
-    # decode kernel (ops/paged_attention.py).  Neither adds parameters, so
-    # one set of params serves Transformers differing only in these fields.
+    # decode kernel; "flash_prefill" the chunk-wide flash prefill kernel
+    # (both in ops/paged_attention.py — the choice is static config because
+    # a verify window and a short prefill chunk are indistinguishable by
+    # runtime shape).  None adds parameters, so one set of params serves
+    # Transformers differing only in these fields.
     paged_kernel: str = "xla"
     # pallas interpret-mode override for the paged kernel; None = auto
     # (interpret off TPU — the CPU-testing discipline)
@@ -226,16 +229,18 @@ class TransformerConfig:
             )
         if self.sliding_window is not None and self.sliding_window <= 0:
             raise ValueError(f"sliding_window must be positive, got {self.sliding_window}")
-        if self.paged_kernel not in ("xla", "pallas"):
+        if self.paged_kernel not in ("xla", "pallas", "flash_prefill"):
             raise ValueError(
-                f"Unknown paged_kernel {self.paged_kernel!r}; choose 'xla' or 'pallas'"
+                f"Unknown paged_kernel {self.paged_kernel!r}; choose 'xla', "
+                "'pallas' or 'flash_prefill'"
             )
-        if self.paged_kernel == "pallas" and (
+        if self.paged_kernel != "xla" and (
             self.sliding_window is not None or self.positional == "alibi"
         ):
             raise ValueError(
-                "paged_kernel='pallas' supports full-causal rope/learned models; "
-                "sliding_window and alibi need the 'xla' reference path"
+                f"paged_kernel={self.paged_kernel!r} supports full-causal "
+                "rope/learned models; sliding_window and alibi need the "
+                "'xla' reference path"
             )
 
     @classmethod
@@ -547,6 +552,7 @@ class Attention(nn.Module):
                 kv_qmax,
                 paged_attention,
                 paged_attention_reference,
+                paged_flash_prefill,
                 paged_insert,
                 paged_quantized_insert,
             )
@@ -567,6 +573,11 @@ class Attention(nn.Module):
                 sk = sv = None
             if cfg.paged_kernel == "pallas":
                 out = paged_attention(
+                    q, pages_k, pages_v, tables, index,
+                    k_scales=sk, v_scales=sv, interpret=cfg.paged_interpret,
+                )
+            elif cfg.paged_kernel == "flash_prefill":
+                out = paged_flash_prefill(
                     q, pages_k, pages_v, tables, index,
                     k_scales=sk, v_scales=sv, interpret=cfg.paged_interpret,
                 )

@@ -59,6 +59,20 @@ def _pick_block(seq: int, target: int) -> int:
     )
 
 
+def pick_block_divisor(seq: int, cap: int = 128) -> int:
+    """Largest power-of-two divisor of ``seq`` not exceeding ``cap`` — for
+    kernels whose q-blocks must tile the sequence *exactly* (no ragged tail
+    block) while keeping per-block VMEM scratch bounded.  Unlike
+    :func:`_pick_block` it never fails: every length divides by 1, so odd
+    lengths degrade to unblocked rather than raising.  Shared with the paged
+    prefill kernel (:mod:`.paged_attention`), whose chunk buckets are
+    power-of-two-friendly page multiples."""
+    for b in (128, 64, 32, 16, 8, 4, 2, 1):
+        if b <= cap and seq % b == 0:
+            return b
+    return 1
+
+
 def _broadcast_segments(segment_ids: jax.Array, sq: int, sk: int):
     """[B, S] -> lane-replicated q ids [B, Sq, 128] and sublane-replicated kv ids [B, 8, Sk]."""
     q_ids = jax.lax.broadcast_in_dim(segment_ids[:, :sq], (segment_ids.shape[0], sq, NUM_LANES), (0, 1))

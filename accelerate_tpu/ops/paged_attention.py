@@ -18,6 +18,11 @@ HBM even when the lane holds three tokens.  This module removes the gather:
   sharing a KV head into the row dimension (same trick as
   :mod:`.flash_attention`).  ``interpret=`` runs the identical kernel on CPU —
   the tier-1 testing discipline.
+* :func:`paged_flash_prefill` — the prefill-side twin: chunk-wide queries
+  walk the same scalar-prefetched block tables with a flash online softmax,
+  q-blocked with each block's page walk cut at its causal frontier, so a
+  prefill chunk reads prior pages in place instead of the gather/scatter
+  round-trip.  :func:`paged_flash_prefill_reference` is its pure-XLA oracle.
 * :func:`paged_attention_reference` — pure-XLA oracle and fallback: a
   live-masked page gather (the satellite fix — dead table slots gather the
   null page instead of whole stale pages) feeding the exact
@@ -44,7 +49,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import DEFAULT_MASK_VALUE, NUM_LANES, _default_interpret
+from .flash_attention import (
+    DEFAULT_MASK_VALUE,
+    NUM_LANES,
+    _default_interpret,
+    pick_block_divisor,
+)
 from .fp8 import E4M3_MAX
 
 #: reserved garbage-sink page id — must match ``serving.paging.NULL_PAGE``
@@ -80,12 +90,21 @@ def kv_qmax(dtype) -> Optional[float]:
     return None
 
 
-def resolve_paged_kernel(kernel: str, mesh=None, tp_axis: str = "tp") -> str:
+def resolve_paged_kernel(kernel: str, mesh=None, tp_axis: str = "tp",
+                         role: str = "decode") -> str:
     """Shard-aware kernel dispatch: under a tensor-parallel mesh the Pallas
     grid would read whole ``(kv-head, page)`` tiles of a head-sharded pool, so
-    ``"pallas"`` falls back to :func:`paged_attention_reference` — the pure-XLA
-    einsum partitions head-parallel under GSPMD for free.  tp=1 meshes (and no
-    mesh at all) keep the requested kernel."""
+    ``"pallas"`` falls back to the pure-XLA reference — the einsum partitions
+    head-parallel under GSPMD for free.  tp=1 meshes (and no mesh at all) keep
+    the requested kernel.
+
+    ``role`` names which pool program is being resolved — ``"decode"``
+    (:func:`paged_attention`) or ``"prefill"`` (:func:`paged_flash_prefill`).
+    Both kernels walk the same head-sharded page pool through the same
+    scalar-prefetched block tables, so the fallback condition is identical;
+    the arm exists so no caller can route prefill around the sharding check."""
+    if role not in ("decode", "prefill"):
+        raise ValueError(f"unknown paged-kernel role {role!r}")
     if kernel != "pallas" or mesh is None:
         return kernel
     tp = mesh.shape[tp_axis] if tp_axis in mesh.axis_names else 1
@@ -376,4 +395,189 @@ def paged_attention(q, pages_k, pages_v, tables, lengths, k_scales=None,
         out.reshape(n, hkv, rep, s, d)
         .reshape(n, hq, s, d)
         .transpose(0, 2, 1, 3)
+    )
+
+
+# ------------------------------------------------------------------- prefill
+def paged_flash_prefill_reference(q, pages_k, pages_v, tables, lengths,
+                                  k_scales=None, v_scales=None, window=None,
+                                  alibi: bool = False):
+    """Pure-XLA prefill oracle: the exact program :func:`paged_flash_prefill`
+    must reproduce.  Chunk-wide queries against paged KV share the decode
+    reference's math — query ``i`` sits at ``lengths[n] + i`` and sees keys
+    ``j <= lengths[n] + i``, which covers both the attention over prior pages
+    and the in-chunk causal triangle (the chunk's own KV is inserted before
+    the call, exactly like decode) — so this is a documented delegation, not
+    a reimplementation.  It is also the tp>1 fallback
+    (:func:`resolve_paged_kernel` with ``role="prefill"``)."""
+    return paged_attention_reference(
+        q, pages_k, pages_v, tables, lengths,
+        k_scales=k_scales, v_scales=v_scales, window=window, alibi=alibi,
+    )
+
+
+def _paged_prefill_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                          ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                          page: int, block_q: int, rep: int, scale: float,
+                          quantized: bool):
+    """One (lane, kv-head, q-block, page) step of the prefill online softmax.
+
+    Query-major GQA fold: row ``r`` of a q-block holds query head
+    ``h * rep + r % rep`` at in-chunk offset ``iq * block_q + r // rep`` —
+    query-major (unlike the decode kernel's group-major fold) so each q-block
+    covers one contiguous query span and the causal page walk can stop at that
+    span's frontier.  Pages are the innermost grid dimension, so the m/l/acc
+    VMEM scratch carries across a q-block's page walk; pages whose first key
+    lies past the block's last query position are skipped outright — that
+    bound subsumes the dead-page check (a dead slot's index degenerates to the
+    null page, fetched at most once and never past any lane's frontier)."""
+    lane, iq, p = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    n_p = pl.num_programs(3)
+    rows = acc_ref.shape[0]
+    head_dim = acc_ref.shape[-1]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[lane]
+
+    @pl.when(p * page <= length + (iq + 1) * block_q - 1)
+    def _compute():
+        k = k_ref[0, :, 0, :]
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, 0]
+            v = v.astype(jnp.float32) * vs_ref[0, 0]
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        s = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                      # [rows, page]
+        j = p * page + jax.lax.broadcasted_iota(jnp.int32, (rows, page), 1)
+        qi = (iq * block_q
+              + jax.lax.broadcasted_iota(jnp.int32, (rows, page), 0) // rep)
+        s = jnp.where(j <= length + qi, s, DEFAULT_MASK_VALUE)
+
+        if page >= NUM_LANES:
+            lane_bcast = lambda a: jnp.tile(a[:, :1], (1, page))
+        else:
+            lane_bcast = lambda a: a[:, :page]
+
+        m_prev = m_ref[...]                                    # [rows, 128]
+        l_prev = l_ref[...]
+        m_curr = jnp.max(s, axis=1)[:, None]
+        m_next = jnp.maximum(m_prev, m_curr)
+        prob = jnp.exp(s - lane_bcast(m_next))
+        alpha = jnp.exp(m_prev - m_next)
+        m_ref[...] = m_next
+        l_ref[...] = alpha * l_prev + jnp.sum(prob, axis=1)[:, None]
+        pv = jax.lax.dot(
+            prob, v.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * acc_bcast_store(alpha, head_dim) + pv
+
+    @pl.when(p == n_p - 1)
+    def _store():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[...] = (acc_ref[...] / acc_bcast_store(l_safe, head_dim))[
+            None, None
+        ].astype(o_ref.dtype)
+
+
+def paged_flash_prefill(q, pages_k, pages_v, tables, lengths, k_scales=None,
+                        v_scales=None, interpret: Optional[bool] = None):
+    """Flash-attention prefill over paged KV, reading pages in place.
+
+    The prefill-side twin of :func:`paged_attention`: chunk-wide queries
+    instead of a decode step's one-or-few.  The chunk's K/V must already be
+    scattered into the pool (:func:`paged_insert` /
+    :func:`paged_quantized_insert` — scatter-time quantization with the
+    per-page scales), so the causal online softmax over prior pages and the
+    in-chunk triangle are one uniform page walk.
+
+    Parameters
+    ----------
+    q: ``[N, S, Hq, D]`` — the chunk's queries; query ``i`` of lane ``n``
+        sits at position ``lengths[n] + i``.
+    pages_k, pages_v: the page pool ``[NP, page, Hkv, D]`` for ONE layer.
+    tables: ``[N, P]`` int32 per-lane block tables; dead slots hold the null
+        page.
+    lengths: ``[N]`` int32 — each lane's valid length before this chunk (the
+        chunk base offset).
+    k_scales, v_scales: ``[NP, Hkv]`` f32 per-page-per-head scales; required
+        iff the pages are a quantized format.
+    interpret: pallas interpret mode (defaults to True off TPU).
+
+    Returns ``[N, S, Hq, D]`` in ``q.dtype``.  Grid: one program per
+    (lane, kv-head, q-block) marching over the lane's pages innermost, with
+    the page walk cut at each q-block's causal frontier — early q-blocks of a
+    late chunk never touch the chunk's own later pages."""
+    if interpret is None:
+        interpret = _default_interpret()
+    n, s, hq, d = q.shape
+    num_pages, page, hkv, _ = pages_k.shape
+    num_p = tables.shape[1]
+    rep = hq // hkv
+    quantized = kv_qmax(pages_k.dtype) is not None
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("quantized pages need k_scales/v_scales")
+    if not quantized:
+        k_scales = jnp.ones((num_pages, hkv), jnp.float32)
+        v_scales = k_scales
+
+    block_q = pick_block_divisor(s)
+    n_qb = s // block_q
+    rows = block_q * rep
+
+    # fold GQA groups into rows QUERY-major: row r = i * rep + g  ->  head
+    # h*rep + g, query i — a q-block of ``block_q * rep`` rows covers one
+    # contiguous query span across all groups of the kv head
+    qf = (
+        q.reshape(n, s, hkv, rep, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(n, hkv, s * rep, d)
+    )
+    lengths = lengths.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, hkv, n_qb, num_p),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d), lambda i, h, b, p, t, ln: (i, h, b, 0)),
+            pl.BlockSpec((1, page, 1, d),
+                         lambda i, h, b, p, t, ln: (t[i, p], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, d),
+                         lambda i, h, b, p, t, ln: (t[i, p], 0, h, 0)),
+            pl.BlockSpec((1, 1), lambda i, h, b, p, t, ln: (t[i, p], h),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i, h, b, p, t, ln: (t[i, p], h),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, d),
+                               lambda i, h, b, p, t, ln: (i, h, b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, NUM_LANES), jnp.float32),
+            pltpu.VMEM((rows, NUM_LANES), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_prefill_kernel,
+        page=page, block_q=block_q, rep=rep, scale=d ** -0.5,
+        quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, hkv, s * rep, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, qf, pages_k, pages_v, k_scales, v_scales)
+    return (
+        out.reshape(n, hkv, s, rep, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(n, s, hq, d)
     )

@@ -162,6 +162,33 @@ class ServingEngine:
         (asserted by tests and ``bench_inference.py --kernel-ab``) but the
         online softmax is not bitwise the full-view softmax.  Requires
         ``paged=True``; full-causal rope/learned models only.
+    prefill_kernel: attention program for the paged *prefill chunk*
+        executables.  ``None`` (default) follows the resolved
+        ``decode_kernel`` — a pool that decodes through the Pallas kernel
+        prefills through its chunk-wide twin
+        (:func:`~accelerate_tpu.ops.paged_attention.paged_flash_prefill`),
+        a pool on the XLA reference stays on it.  ``"pallas"`` reads prior
+        pages in place with a q-blocked flash online softmax and writes the
+        chunk's K/V straight into the page pool (scatter-time quantization
+        included) — no gather temporary, no scatter round-trip.  ``"xla"``
+        forces the gather/scatter reference path (the tp>1 fallback, and the
+        bisection knob when a prefill divergence is suspected).  Same
+        compiled-shape budget either way (the kernel replaces the per-bucket
+        prefill executables' attention, it adds none).  Requires
+        ``paged=True``; full-causal rope/learned models only.
+    interleave_prefill: dispatch each step's prefill chunks *behind* the
+        decode window instead of ahead of it (requires ``paged=True``).
+        The decode window is issued first and its tokens stay in flight
+        (``async_depth=1``) while the host schedules and enqueues the cycle's
+        chunks back-to-back behind it; the scheduler charges decode tokens
+        and prefill tokens against ONE joint per-cycle budget
+        (:meth:`.Scheduler.begin_step`), so decode lanes never skip a cycle
+        while a long prompt prefills, and up to ``num_slots`` requests may
+        be mid-prefill at once with chunks picked shortest-remaining-first —
+        a chat prompt lands its one chunk next cycle even while a 100k-token
+        prompt streams.  Greedy/sampled outputs are token-identical to the
+        default prefill-ahead ordering (lane RNG folds from the request id,
+        never from arrival order).
     kv_dtype: KV page storage format (requires ``paged=True``).  ``None``
         keeps the model dtype (token-identical); ``"bf16"`` stores bf16;
         ``"int8"`` / ``"fp8"`` quantize pages with per-(page, kv-head) f32
@@ -244,6 +271,8 @@ class ServingEngine:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         decode_kernel: str = "xla",
+        prefill_kernel: Optional[str] = None,
+        interleave_prefill: bool = False,
         kv_dtype: Optional[str] = None,
         mesh=None,
         tp_axis: str = "tp",
@@ -296,15 +325,33 @@ class ServingEngine:
         #: the at-most-one in-flight window handle (depth-1 pipeline); None
         #: when the pipeline is empty (always, under async_depth=0)
         self._inflight: Optional[Readback] = None
+        #: the PREVIOUS window's handle, parked between this cycle's dispatch
+        #: and its drain at the end of _step_impl — non-None only inside that
+        #: span, so admission work running in between (interleaved prefill)
+        #: can reach it and any forced flush drains oldest-first
+        self._prev_handle: Optional[Readback] = None
 
         self.paged = bool(paged)
         if decode_kernel not in ("xla", "pallas"):
             raise ValueError(
                 f"decode_kernel must be 'xla' or 'pallas', got {decode_kernel!r}"
             )
-        if (decode_kernel != "xla" or kv_dtype is not None) and not self.paged:
+        if prefill_kernel not in (None, "xla", "pallas"):
             raise ValueError(
-                "decode_kernel/kv_dtype act on the paged KV pool; pass paged=True"
+                f"prefill_kernel must be None, 'xla' or 'pallas', "
+                f"got {prefill_kernel!r}"
+            )
+        if (decode_kernel != "xla" or prefill_kernel == "pallas"
+                or kv_dtype is not None) and not self.paged:
+            raise ValueError(
+                "decode_kernel/prefill_kernel/kv_dtype act on the paged KV "
+                "pool; pass paged=True"
+            )
+        self.interleave_prefill = bool(interleave_prefill)
+        if self.interleave_prefill and not self.paged:
+            raise ValueError(
+                "interleave_prefill needs the paged pool (the legacy batch-1 "
+                "prefill scratch admits one request at a time); pass paged=True"
             )
         from ..ops.paged_attention import (
             kv_qmax,
@@ -317,6 +364,14 @@ class ServingEngine:
         # resolves to the XLA reference (head-parallel under GSPMD for free)
         decode_kernel = resolve_paged_kernel(decode_kernel, mesh, tp_axis)
         self.decode_kernel = decode_kernel
+        # prefill follows the resolved decode kernel unless forced: a pool
+        # decoding through Pallas prefills through its chunk-wide twin, and
+        # the tp>1 fallback applies to both independently
+        if prefill_kernel is None:
+            prefill_kernel = decode_kernel if self.paged else "xla"
+        self.prefill_kernel = resolve_paged_kernel(
+            prefill_kernel, mesh, tp_axis, role="prefill"
+        )
         self.kv_dtype = kv_dtype
 
         self.quantized = kv_qmax(kv_storage_dtype(kv_dtype, cfg.dtype)) is not None
@@ -326,6 +381,9 @@ class ServingEngine:
         # Native-dtype XLA stays on the PR-6 gathered path — bitwise identity
         # with the slab pool, plus the live-page gather mask.
         self._direct = self.quantized or decode_kernel == "pallas"
+        # the prefill-side twin of the flag: quantized pools and the flash
+        # prefill kernel both need the chunk forward to own the page writes
+        self._prefill_direct = self.quantized or self.prefill_kernel == "pallas"
         if self.paged:
             self.page_size = int(
                 page_size if page_size is not None
@@ -419,14 +477,20 @@ class ServingEngine:
         if self.debug_server is not None:
             self.debug_server.add_collector(self.analyze_costs)
         # Window models: the direct paged windows run a Transformer whose
-        # config selects the decode kernel (and interpret default).  The
+        # config selects the attention kernel (and interpret default).  The
         # fields carry no parameters, so the engine's params serve every
-        # variant; prefill always runs the XLA reference program — chunk-wide
-        # queries gain nothing from a decode-shaped kernel, and it keeps the
-        # written KV identical across kernels.
+        # variant.  The prefill model picks its own kernel: the chunk-wide
+        # flash kernel under prefill_kernel="pallas", the XLA reference
+        # otherwise — either way the page writes go through the same insert
+        # path, so the written KV is identical across kernels.
         if self.paged and self._direct:
             kmodel = Transformer(dataclasses.replace(cfg, paged_kernel=decode_kernel))
-            pmodel = Transformer(dataclasses.replace(cfg, paged_kernel="xla"))
+        if self.paged and self._prefill_direct:
+            pmodel = Transformer(dataclasses.replace(
+                cfg,
+                paged_kernel=("flash_prefill" if self.prefill_kernel == "pallas"
+                              else "xla"),
+            ))
         # budget=1 per executable: the engine's whole design promises exactly
         # one compiled shape each — any second signature is a bug worth a warning
         if self.paged and self._direct:
@@ -452,8 +516,9 @@ class ServingEngine:
         self._prefill = {
             b: RecompileWatchdog(
                 make_paged_prefill_chunk(
-                    pmodel if self.quantized else model, b, self.page_size,
-                    direct=self.quantized, shardings=self._shardings,
+                    pmodel if self._prefill_direct else model, b,
+                    self.page_size, direct=self._prefill_direct,
+                    shardings=self._shardings,
                 ) if self.paged
                 else make_prefill_chunk(model, b, shardings=self._shardings),
                 name=f"serve/prefill_{b}", budget=1, registry=self.metrics,
@@ -525,6 +590,9 @@ class ServingEngine:
             prefix_cache=self.prefix_cache,
             recorder=self.recorder,
             max_queue=max_queue,
+            # interleaved mode keeps up to one open prefill per slot so a
+            # short prompt's chunk can land SRTF ahead of a long one's
+            max_prefills=self.num_slots if self.interleave_prefill else 1,
         )
         #: label of the parameter set currently served; rotated by swap_params
         self.weights_version = str(weights_version)
@@ -552,7 +620,9 @@ class ServingEngine:
         #: concurrency headline; tracked in both modes for A/B benches)
         self.peak_active_lanes = 0
         self._base_rng = jax.random.PRNGKey(rng_seed)
-        self._reserved_slot: Optional[int] = None
+        # slots held for requests mid-prefill (one per open prefill; a set
+        # because interleaved mode keeps several prefills in flight at once)
+        self._reserved_slots: set = set()
         # device-resident mirror of the lane vectors above (uploaded once,
         # then edited in place: decode/verify carry pending/rng device-side,
         # installs scatter one slot, frees re-upload the active mask) —
@@ -569,6 +639,7 @@ class ServingEngine:
             "tokens_generated": 0,
             "prefill_chunks": 0,
             "prefill_tokens": 0,
+            "interleaved_chunks": 0,
             "decode_steps": 0,
             "occupied_lane_steps": 0,
             "slots_reused": 0,
@@ -591,6 +662,9 @@ class ServingEngine:
             "serve/ttft_s", buckets=_LATENCY_BUCKETS,
             help="submit-to-first-token wall time",
         )
+        # per-traffic-class TTFT histograms, created lazily on the first
+        # request carrying each class label (serve/ttft_s_class_<class>)
+        self._class_ttft_hists: dict = {}
         self._token_hist = self.metrics.histogram(
             "serve/token_latency_s", buckets=_LATENCY_BUCKETS,
             help="inter-token wall time (first token = TTFT)",
@@ -624,6 +698,33 @@ class ServingEngine:
             help="info gauge: decode attention program — 1 = pallas "
                  "(in-place paged kernel), 0 = xla (gather reference)",
         ).set(1.0 if self.decode_kernel == "pallas" else 0.0)
+        self.metrics.gauge(
+            "serve/prefill_kernel",
+            help="info gauge: prefill attention program — 1 = pallas "
+                 "(paged flash prefill), 0 = xla (gather/scatter reference)",
+        ).set(1.0 if self.prefill_kernel == "pallas" else 0.0)
+        self._pf_rate_gauge = self.metrics.gauge(
+            "serve/prefill_tokens_per_s",
+            help="prefill throughput over the trailing steps that ran at "
+                 "least one chunk (valid tokens / wall time between them)",
+        )
+        self._interleave_gauge = self.metrics.gauge(
+            "serve/prefill_interleave_ratio",
+            help="fraction of prefill chunks dispatched BEHIND a same-cycle "
+                 "decode window (interleaved chunked prefill); 0 by "
+                 "definition under the default prefill-ahead ordering",
+        )
+        # trailing-rate state for serve/prefill_tokens_per_s
+        self._pf_last_t: Optional[float] = None
+        self._pf_last_tokens = 0
+        # device quant-error handles from this cycle's prefill chunks; they
+        # attach to the next dispatched window's Readback and are folded into
+        # the quant-error gauge at drain (fetching here would sync the pipe)
+        self._pending_prefill_qerr: List = []
+        # tokens charged by the decode window dispatched this cycle; _admit
+        # subtracts it from the scheduler's joint per-cycle budget when the
+        # interleaved ordering dispatched decode first
+        self._cycle_decode_tokens = 0
         self.metrics.gauge(
             "serve/tp_degree",
             help="info gauge: tensor-parallel degree the params and KV pool "
@@ -716,6 +817,7 @@ class ServingEngine:
         cache_prefix: bool = True,
         speculate: bool = True,
         deadline_s: Optional[float] = None,
+        request_class: Optional[str] = None,
         **overrides: Any,
     ) -> Request:
         """Queue one request; returns its :class:`Request` handle (filled in
@@ -728,7 +830,12 @@ class ServingEngine:
         verification rejects).  ``deadline_s`` is an SLO budget from submit:
         admission sheds (retriable refusal) when the queue-depth estimate
         says it cannot be met, and the per-step deadline sweep cancels the
-        request (``deadline_exceeded`` set) if a running lane blows it."""
+        request (``deadline_exceeded`` set) if a running lane blows it.
+        ``request_class`` is a free-form traffic label (e.g. ``"chat"``,
+        ``"batch"``): TTFT is additionally observed into a per-class
+        histogram ``serve/ttft_s_class_<class>`` so one tenant's long
+        prompts can't hide another's latency regression in the blended
+        percentile."""
         gen = config or GenerationConfig()
         if overrides:
             gen = dataclasses.replace(gen, **overrides)
@@ -790,7 +897,8 @@ class ServingEngine:
         req = Request(rid=self._next_rid, prompt=prompt, config=gen, on_token=on_token,
                       submit_step=self._step_count, submit_time=now, last_token_time=now,
                       cache_prefix=bool(cache_prefix), speculate=bool(speculate),
-                      deadline_s=None if deadline_s is None else float(deadline_s))
+                      deadline_s=None if deadline_s is None else float(deadline_s),
+                      request_class=request_class)
         self._next_rid += 1
         self.scheduler.submit(req)
         self._bump("requests_submitted")
@@ -854,8 +962,9 @@ class ServingEngine:
         return (
             not self._active.any()
             and self._inflight is None
-            and self.scheduler.prefilling is None
-            and self._reserved_slot is None
+            and self._prev_handle is None
+            and not self.scheduler.prefills
+            and not self._reserved_slots
         )
 
     def swap_params(self, params: Any, version: Optional[str] = None) -> None:
@@ -948,18 +1057,18 @@ class ServingEngine:
             req = self._slot_req[s]
             if req is not None and req.state is RequestState.RUNNING:
                 out.append(req)
-        if self._inflight is not None:
+        for hd in (self._prev_handle, self._inflight):
+            if hd is None:
+                continue
             # a pre-freed lane's request left _slot_req when its final window
             # dispatched but is still owed that window's tokens from the
             # drain this engine will never run — it lives only on the handle
-            for s in self._inflight.prefreed:
-                req = self._inflight.reqs[s]
+            for s in hd.prefreed:
+                req = hd.reqs[s]
                 if (req is not None and req.state is RequestState.RUNNING
                         and not any(req is r for r in out)):
                     out.append(req)
-        if self.scheduler.prefilling is not None:
-            out.append(self.scheduler.prefilling)
-            self.scheduler.prefilling = None
+        out.extend(self.scheduler.take_prefills())
         out.extend(self.scheduler.queue)
         self.scheduler.queue.clear()
         for req in out:
@@ -1035,8 +1144,10 @@ class ServingEngine:
         device mirrors are dropped wholesale — the next dispatch re-uploads
         them fresh rather than trusting vectors a dying window may have
         corrupted."""
-        hd, self._inflight = self._inflight, None
-        if hd is not None:
+        handles = [h for h in (self._prev_handle, self._inflight)
+                   if h is not None]
+        self._prev_handle = self._inflight = None
+        for hd in handles:
             try:
                 fetch(hd.toks)  # sync: proves the window's writes landed
             except Exception as exc:
@@ -1046,11 +1157,13 @@ class ServingEngine:
             if self.paged and hd.deferred_pages:
                 hd.settle(self.kv.allocator)
         self._stale_handles.clear()
+        self._pending_prefill_qerr.clear()
+        self._cycle_decode_tokens = 0
         for s in range(self.num_slots):
             if self._active[s] or self._slot_req[s] is not None:
                 self._retire_lane(s)
-        self.scheduler.prefilling = None
-        self._reserved_slot = None
+        self.scheduler.take_prefills()
+        self._reserved_slots.clear()
         for req in list(self.scheduler.queue):
             # export_inflight normally emptied this; anything left has no
             # owner to stream to — drop it cleanly with its pins
@@ -1103,8 +1216,7 @@ class ServingEngine:
                 "serve/deadline_shed", where="queued", rid=req.rid,
                 deadline_s=req.deadline_s, elapsed_s=elapsed,
             )
-        pre = self.scheduler.prefilling
-        if pre is not None and pre.deadline_s is not None:
+        if any(r.deadline_s is not None for r in self.scheduler.prefills):
             any_live = True  # finishes its chunks; the running sweep catches it
         self._has_deadlines = any_live
 
@@ -1116,7 +1228,8 @@ class ServingEngine:
         # in-flight writes to the slot are overwritten by insert/prefill,
         # which queue behind the window on device
         for s in self.slot_order:
-            if not self._active[s] and self._slot_req[s] is None and s != self._reserved_slot:
+            if (not self._active[s] and self._slot_req[s] is None
+                    and s not in self._reserved_slots):
                 return s
         return None
 
@@ -1124,31 +1237,42 @@ class ServingEngine:
         # paused admission (drain / hot-swap): never START a prefill, but a
         # request already mid-prefill finishes — abandoning it would leak its
         # reserved slot and cache pins
-        if self.admission_paused and self.scheduler.prefilling is None:
+        if self.admission_paused and not self.scheduler.prefills:
             return
-        budget = self.scheduler.begin_step()
+        # joint per-cycle budget: in interleaved mode the decode window
+        # dispatched before admission and charged its tokens; the default
+        # ordering charges zero (decode dispatches after)
+        budget = self.scheduler.begin_step(self._cycle_decode_tokens)
         while True:
-            if self.scheduler.prefilling is None:
-                if self.admission_paused:
-                    return
-                slot = self._next_free_slot()
-                if slot is None or not self.scheduler.queue:
-                    return
-                if self.paged and not self._admission_pages_ok(self.scheduler.queue[0]):
-                    return
-                self.scheduler.start_next(slot)
-                self._reserved_slot = slot
-                if not self.paged:
-                    # scratch restarts at position 0; stale KV beyond each new
-                    # write is unreachable (causal mask == valid-entry mask)
-                    self.scratch = self.scratch.replace(
-                        index=self._put(jnp.zeros((), jnp.int32))
-                    )
-            if self.paged and not self._ensure_prefill_pages():
-                return  # page pressure: pause prefill, retry next step
-            took = self.scheduler.take_chunk(budget)
-            if took is None:
+            if not self.admission_paused:
+                # open prefills up to the scheduler's cap (1, or one per slot
+                # in interleaved mode) while slots and pages allow
+                while (self.scheduler.queue
+                       and len(self.scheduler.prefills)
+                       < self.scheduler.max_prefills):
+                    slot = self._next_free_slot()
+                    if slot is None:
+                        break
+                    if self.paged and not self._admission_pages_ok(
+                            self.scheduler.queue[0]):
+                        break
+                    self.scheduler.start_next(slot)
+                    self._reserved_slots.add(slot)
+                    if not self.paged:
+                        # scratch restarts at position 0; stale KV beyond each
+                        # new write is unreachable (causal mask == valid-entry
+                        # mask)
+                        self.scratch = self.scratch.replace(
+                            index=self._put(jnp.zeros((), jnp.int32))
+                        )
+            if not self.scheduler.prefills:
                 return
+            took = self.scheduler.take_chunk(
+                budget,
+                ready=self._ensure_prefill_pages if self.paged else None,
+            )
+            if took is None:
+                return  # budget spent or page pressure: retry next step
             req, bucket, valid, start, cached = took
             ptoks = req.prefill_tokens
             if cached:
@@ -1181,6 +1305,10 @@ class ServingEngine:
                         self.scratch = self._prefill[bucket](self.params, chunk[None], self.scratch)
                 budget -= bucket
                 self._bump("prefill_chunks")
+                if self.interleave_prefill and self._cycle_decode_tokens:
+                    # a decode window was dispatched this same cycle and this
+                    # chunk queued behind it: the interleave actually happened
+                    self._bump("interleaved_chunks")
                 if self.prefix_cache is not None and req.cache_prefix:
                     self._bump("prefix_miss_tokens", valid)
                     self._populate_cache(req, bucket, valid, start, ptoks)
@@ -1210,13 +1338,12 @@ class ServingEngine:
             return True
         return self._reclaim_pages(need, allow_preempt=False)
 
-    def _ensure_prefill_pages(self) -> bool:
-        """Pages for the prefilling request's NEXT chunk (called before
-        ``take_chunk``).  False pauses prefill for this engine step — running
-        lanes keep decoding, their completions free pages, and the stalled
-        chunk retries next step."""
-        req = self.scheduler.prefilling
-        if req is None or req.next_chunk >= len(req.chunks):
+    def _ensure_prefill_pages(self, req: Request) -> bool:
+        """Pages for ``req``'s NEXT chunk (the scheduler's ``ready`` predicate
+        inside ``take_chunk``).  False skips this request for this engine step
+        — running lanes keep decoding, their completions free pages, and the
+        stalled chunk retries next step (or SRTF picks a smaller prefill)."""
+        if req.next_chunk >= len(req.chunks):
             return True
         if req.next_chunk < req.cached_chunks:
             return True  # cached chunk: aliases resident pages, allocates none
@@ -1240,7 +1367,7 @@ class ServingEngine:
         kv = self.kv
         table = self._put(kv.tables[s])
         base = self._put(jnp.int32(start))
-        if self.quantized:
+        if self._prefill_direct:
             args = (self.params, chunk[None], kv.pages_k, kv.pages_v,
                     kv.k_scales, kv.v_scales, table, base)
             self.cost_table.capture(
@@ -1249,7 +1376,11 @@ class ServingEngine:
             with self.tracer.span("serve/prefill_chunk", bucket=bucket, valid=valid):
                 (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
                  qerr) = self._prefill[bucket](*args)
-            self._kv_quant_gauge.set(float(fetch(qerr)))
+            if self.quantized:
+                # don't fetch() here — that would sync the pipeline right
+                # behind the chunk; park the handle and fold it into the
+                # gauge when the next window drains
+                self._pending_prefill_qerr.append(qerr)
             return
         self.cost_table.capture(
             f"serve/prefill_{bucket}", self._prefill[bucket],
@@ -1274,7 +1405,9 @@ class ServingEngine:
         while self.kv.allocator.free_count < need:
             if self.prefix_cache is not None and self.prefix_cache.evict_one():
                 continue
-            if self._inflight is not None and self._inflight.deferred_pages:
+            if ((self._inflight is not None and self._inflight.deferred_pages)
+                    or (self._prev_handle is not None
+                        and self._prev_handle.deferred_pages)):
                 self._drain_inflight()
                 continue
             if allow_preempt and self._preempt():
@@ -1484,7 +1617,7 @@ class ServingEngine:
             self._bump("slots_reused")
         self._slot_ever_used[s] = True
         self._slot_req[s] = req
-        self._reserved_slot = None
+        self._reserved_slots.discard(s)
         # the slot owns a full KV copy now; the radix nodes this request read
         # or populated can be evicted without affecting it
         if self.prefix_cache is not None and req.cache_nodes:
@@ -1603,21 +1736,30 @@ class ServingEngine:
                 self._retire_lane(s)
                 self._bump("prefreed_lanes")
 
-    def _decode_window(self) -> None:
-        """One decode phase over the pool: a speculative verify cycle when
-        any lane has an n-gram draft, the plain decode window otherwise.
+    def _dispatch_decode(self) -> Optional["Readback"]:
+        """Dispatch one decode phase over the pool — a speculative verify
+        cycle when any lane has an n-gram draft, the plain decode window
+        otherwise — and return the handle the caller must drain (the
+        *previous* window under the depth-1 pipeline, this window itself
+        under ``async_depth=0``, ``None`` when the pool is idle).
 
-        Pipelining (``async_depth=1``): the window dispatched here is NOT
-        materialized here — it parks in ``self._inflight`` and the previous
-        window's tokens are drained *after* the new dispatch, so ``_emit``,
-        streaming callbacks, and the next step's admission all run while the
-        device computes.  Speculative cycles drain first instead: drafting
-        and the verify token block need the previous window's tokens."""
+        Dispatch and drain are split so the step loop can run admission
+        between them: with ``interleave_prefill`` the prefill chunk enqueues
+        *behind* the window dispatched here, decode lanes never skip a cycle
+        while a long prompt prefills, and the chunk still finishes under the
+        host work of draining the previous window.  Speculative cycles drain
+        first instead: drafting and the verify token block need the previous
+        window's tokens.
+
+        Side effect: ``self._cycle_decode_tokens`` is set to the token count
+        charged by this cycle's window (0 when idle) — ``_admit`` subtracts
+        it from the scheduler's joint per-cycle budget."""
+        self._cycle_decode_tokens = 0
         if self.speculate_k and self._inflight is not None:
             self._drain_inflight()
         if not self._active.any():
             self._drain_inflight()
-            return
+            return None
         if self.paged:
             # map pages for the widest pass this cycle could run (the same
             # span the admission check reserved headroom for); this may
@@ -1625,7 +1767,7 @@ class ServingEngine:
             self._ensure_decode_capacity(max(self.window, self.speculate_k + 1))
             if not self._active.any():
                 self._drain_inflight()
-                return
+                return None
         n_occupied = int(self._active.sum())
         self.peak_active_lanes = max(self.peak_active_lanes, n_occupied)
         self._occupancy_gauge.set(n_occupied / self.num_slots)
@@ -1639,17 +1781,58 @@ class ServingEngine:
             hd = self._verify_cycle(*drafts, n_occupied=n_occupied)
         else:
             hd = self._decode_cycle(n_occupied)
+        self._cycle_decode_tokens = n_occupied * hd.width
         if self.async_depth == 0:
-            self._drain(hd)
-        else:
-            prev, self._inflight = self._inflight, hd
-            if prev is not None:
-                self._drain(prev)
+            return hd
+        prev, self._inflight = self._inflight, hd
+        return prev
+
+    def _decode_window(self) -> None:
+        """Dispatch one decode phase and drain the handle it returns — the
+        non-interleaved step ordering (admission already ran)."""
+        prev = self._dispatch_decode()
+        if prev is not None:
+            self._drain(prev)
+
+    def _update_prefill_gauges(self) -> None:
+        """Publish prefill throughput and the interleave ratio.
+
+        ``serve/prefill_tokens_per_s`` is valid prompt tokens through the
+        prefill executables over wall time between steps that made prefill
+        progress (idle stretches slide the window start so they don't dilute
+        the rate).  ``serve/prefill_interleave_ratio`` is the fraction of
+        forward-pass prefill chunks dispatched in the same cycle as a decode
+        window — ~1.0 means long prompts rode along under decode; ~0.0 means
+        chunks ran on an otherwise idle device (no interleaving to do, or
+        ``interleave_prefill`` off)."""
+        chunks = self.stats["prefill_chunks"]
+        if chunks:
+            self._interleave_gauge.set(
+                self.stats["interleaved_chunks"] / chunks
+            )
+        tokens = self.stats["prefill_tokens"]
+        now = time.perf_counter()
+        if self._pf_last_t is None or tokens < self._pf_last_tokens:
+            self._pf_last_t, self._pf_last_tokens = now, tokens
+            return
+        if tokens == self._pf_last_tokens:
+            self._pf_last_t = now  # no prefill this step: slide the window
+            return
+        dt = now - self._pf_last_t
+        if dt > 0.0:
+            self._pf_rate_gauge.set((tokens - self._pf_last_tokens) / dt)
+        self._pf_last_t, self._pf_last_tokens = now, tokens
 
     def _drain_inflight(self) -> None:
         """Flush the pipeline: materialize the in-flight window (if any) and
         land its tokens.  Called before speculative cycles, when the pool
-        goes idle, and by the page-reclaim ladder to settle deferred pages."""
+        goes idle, and by the page-reclaim ladder to settle deferred pages.
+        Oldest first: a previous window parked mid-step (interleaved
+        admission runs between dispatch and drain) lands before the window
+        dispatched after it, or tokens would interleave out of order."""
+        prev, self._prev_handle = self._prev_handle, None
+        if prev is not None:
+            self._drain(prev)
         hd, self._inflight = self._inflight, None
         if hd is not None:
             self._drain(hd)
@@ -1717,6 +1900,14 @@ class ServingEngine:
         hd.consumed.clear()
         if hd.qerr is not None and self._kv_quant_gauge is not None:
             self._kv_quant_gauge.set(float(fetch(hd.qerr)))
+        if hd.prefill_qerrs and self._kv_quant_gauge is not None:
+            # chunks attached to this handle dispatched no later than the
+            # cycle after it, so their quant errors are (nearly) landed here;
+            # publish the worst chunk of the batch
+            self._kv_quant_gauge.set(
+                max(float(fetch(e)) for e in hd.prefill_qerrs)
+            )
+            hd.prefill_qerrs = []
         if hd.kind == "verify":
             if self.paged:
                 # the write-index mirror advances by what the device actually
@@ -1971,6 +2162,15 @@ class ServingEngine:
                 continue
             if not req.tokens:
                 self._ttft_hist.observe(now - req.submit_time)
+                if req.request_class:
+                    hist = self._class_ttft_hists.get(req.request_class)
+                    if hist is None:
+                        hist = self.metrics.histogram(
+                            f"serve/ttft_s_class_{req.request_class}",
+                            buckets=_LATENCY_BUCKETS,
+                        )
+                        self._class_ttft_hists[req.request_class] = hist
+                    hist.observe(now - req.submit_time)
             for t in toks[s, :n]:
                 req.emit(int(t))
             self._bump("tokens_generated", n)
@@ -2028,12 +2228,39 @@ class ServingEngine:
         queue_depth = self.scheduler.queue_depth
         self._queue_gauge.set(queue_depth)
         self._prefree_exhausted()
-        self._admit()
+        if self.interleave_prefill:
+            # decode-interleaved chunked prefill: dispatch this cycle's
+            # window FIRST, then admit — the chunk enqueues *behind* the
+            # window, so decode lanes never skip a cycle while a long
+            # prompt prefills, and the chunk runs under the host work of
+            # draining the previous window
+            # the previous window parks on the engine while admission runs:
+            # any forced flush inside _admit (page-reclaim ladder) must land
+            # it BEFORE the window just dispatched
+            self._prev_handle = self._dispatch_decode()
+            self._admit()
+        else:
+            # decode dispatches after admission: charge it nothing (the
+            # counter still holds LAST cycle's width otherwise)
+            self._cycle_decode_tokens = 0
+            self._admit()
+            self._prev_handle = self._dispatch_decode()
+        if self._pending_prefill_qerr:
+            # hand the chunk quant-error handles to a window that retires
+            # no earlier than the chunks do — fetched at ITS drain
+            tgt = (self._inflight if self._inflight is not None
+                   else self._prev_handle)
+            if tgt is not None:
+                tgt.prefill_qerrs.extend(self._pending_prefill_qerr)
+                self._pending_prefill_qerr.clear()
+        prev, self._prev_handle = self._prev_handle, None
+        if prev is not None:
+            self._drain(prev)
         if self.prefix_cache is not None:
             covered = self.stats["prefix_hit_tokens"] + self.stats["prefix_miss_tokens"]
             if covered:
                 self._hit_rate_gauge.set(self.stats["prefix_hit_tokens"] / covered)
-        self._decode_window()
+        self._update_prefill_gauges()
         if self.paged:
             self.kv.publish_gauges()
         self._step_count += 1

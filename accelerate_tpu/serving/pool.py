@@ -537,6 +537,9 @@ def make_paged_prefill_chunk(model: Transformer, chunk_len: int, page_size: int,
     so quantized pools requantize each touched page against fresh content.
     Signature becomes ``(params, tokens, pages_k, pages_v, k_scales, v_scales,
     table [P], base) -> (pages_k, pages_v, k_scales, v_scales, quant_err)``.
+    With a ``paged_kernel="flash_prefill"`` model this is the Pallas prefill
+    path (``ops/paged_attention.py::paged_flash_prefill``) — no gather, no
+    scatter round-trip, the chunk attends over prior pages in place.
     """
     if chunk_len % page_size != 0:
         raise ValueError(

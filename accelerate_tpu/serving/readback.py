@@ -85,6 +85,12 @@ class Readback:
     #: engine parks the old references here and lets them die with the
     #: handle, after :func:`fetch` proved the window retired.
     consumed: list = dataclasses.field(default_factory=list)
+    #: device quant-error scalars from prefill chunks dispatched in this
+    #: window's cycle (interleaved chunked prefill): fetching one eagerly
+    #: would sync the pipeline right after the chunk enqueued, so the engine
+    #: parks the handles here and folds them into the quant-error gauge at
+    #: drain — by which point the chunks have long retired behind the window.
+    prefill_qerrs: list = dataclasses.field(default_factory=list)
 
     def lane_live(self, slot: int) -> bool:
         """Was ``slot`` active when this window was dispatched?  A live lane's

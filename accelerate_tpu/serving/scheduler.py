@@ -92,6 +92,9 @@ class Request:
     # ``deadline_exceeded`` so the API layer can answer 504 instead of 500
     deadline_s: Optional[float] = None
     deadline_exceeded: bool = False
+    # traffic-class label ("chat", "batch", ...) for per-class TTFT
+    # histograms; None stays out of the per-class series entirely
+    request_class: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -130,15 +133,24 @@ class Request:
 class Scheduler:
     """FCFS admission with a per-step prefill-token budget.
 
-    One request prefills at a time (the scratch cache is batch-1); its chunks
-    are charged against ``prefill_token_budget`` each engine step, so a long
-    prompt spreads across steps instead of stalling every running request for
-    its whole prefill (chunked prefill, Sarathi-style).
+    By default one request prefills at a time (the legacy scratch cache is
+    batch-1); its chunks are charged against ``prefill_token_budget`` each
+    engine step, so a long prompt spreads across steps instead of stalling
+    every running request for its whole prefill (chunked prefill,
+    Sarathi-style).
+
+    ``max_prefills > 1`` (the interleaved paged engine) keeps several
+    requests mid-prefill at once: admission is still FCFS, but
+    :meth:`take_chunk` picks the chunk to run each step
+    shortest-remaining-first among the open prefills, so a short chat prompt
+    arriving behind a 100k-token prompt finishes its one chunk next step
+    instead of waiting out the giant — iteration-level scheduling on the
+    prefill side, with the budget still the single jitter bound.
     """
 
     def __init__(self, prefill_buckets: Sequence[int], prefill_token_budget: int,
                  prefix_cache=None, recorder=None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None, max_prefills: int = 1):
         self.buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
         if not self.buckets:
             raise ValueError("need at least one prefill bucket")
@@ -156,12 +168,41 @@ class Scheduler:
         self.max_queue = None if max_queue is None else int(max_queue)
         if self.max_queue is not None and self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_prefills = int(max_prefills)
+        if self.max_prefills < 1:
+            raise ValueError(f"max_prefills must be >= 1, got {max_prefills}")
         self.queue: deque = deque()
-        self.prefilling: Optional[Request] = None
+        # requests mid-prefill, in admission order; bounded by max_prefills
+        self._prefills: List[Request] = []
+        # did a forward-pass chunk dispatch since begin_step? (the first one
+        # per cycle is exempt from the joint budget — anti-starvation)
+        self._chunk_this_step = False
         self.prefix_cache = prefix_cache
         # request-lifecycle events for post-mortems (a no-op ring append when
         # telemetry is disabled); the engine passes the process recorder
         self.recorder = recorder if recorder is not None else get_flight_recorder()
+
+    @property
+    def prefills(self) -> Tuple[Request, ...]:
+        """Every request currently mid-prefill, admission order."""
+        return tuple(self._prefills)
+
+    @property
+    def prefilling(self) -> Optional[Request]:
+        """The oldest open prefill (the only one under ``max_prefills=1``) —
+        kept for the single-prefill callers; multi-prefill code should use
+        :attr:`prefills`."""
+        return self._prefills[0] if self._prefills else None
+
+    @prefilling.setter
+    def prefilling(self, req: Optional[Request]) -> None:
+        self._prefills = [] if req is None else [req]
+
+    def take_prefills(self) -> List[Request]:
+        """Detach and return every open prefill (replica export: the engine
+        hands them to the router for replay on a survivor)."""
+        out, self._prefills = self._prefills, []
+        return out
 
     def _match_prefix(self, request: Request) -> None:
         """(Re)walk the radix tree for ``request``'s longest cached prefix and
@@ -258,7 +299,7 @@ class Scheduler:
 
     @property
     def has_queued(self) -> bool:
-        return bool(self.queue) or self.prefilling is not None
+        return bool(self.queue) or bool(self._prefills)
 
     @property
     def queue_depth(self) -> int:
@@ -269,15 +310,31 @@ class Scheduler:
         drain frees its slot immediately, one step after the sync loop would
         have (the documented EOS lag), so queue depth can read one step
         higher than ``async_depth=0`` under churn — never lower."""
-        return len(self.queue) + (self.prefilling is not None)
+        return len(self.queue) + len(self._prefills)
 
-    def begin_step(self) -> int:
-        """Fresh prefill-token budget for this engine step."""
-        return self.budget
+    def begin_step(self, decode_tokens: int = 0) -> int:
+        """Fresh prefill-token budget for this engine step.
+
+        ``decode_tokens`` is what the decode window already dispatched this
+        cycle (interleaved mode: occupied lanes x window width).  Decode and
+        prefill share one per-cycle token budget — the Sarathi/Orca joint
+        bound — so a busy pool shrinks what prefill may add on top, keeping
+        total step latency flat.  Anti-starvation lives in
+        :meth:`take_chunk`, not here: the first forward-pass chunk of each
+        cycle dispatches even over budget (or a chunk wider than the
+        post-decode remainder could never run while any lane decodes, and a
+        full pool under a long prompt livelocks admission); the budget
+        throttles every chunk after it."""
+        self._chunk_this_step = False
+        if decode_tokens <= 0:
+            return self.budget
+        return max(self.budget - int(decode_tokens), 0)
 
     def start_next(self, slot: int) -> Optional[Request]:
-        """Pop the FCFS head into PREFILL state, bound for ``slot``."""
-        if self.prefilling is not None or not self.queue:
+        """Pop the FCFS head into PREFILL state, bound for ``slot``.  Up to
+        ``max_prefills`` requests may be mid-prefill at once; admission order
+        stays FCFS even though :meth:`take_chunk` picks among them SRTF."""
+        if len(self._prefills) >= self.max_prefills or not self.queue:
             return None
         req = self.queue.popleft()
         req.state = RequestState.PREFILL
@@ -285,38 +342,74 @@ class Scheduler:
         # refresh the prefix match: requests admitted since submit may have
         # populated exactly the chunks this one needs (the batch-submit case)
         self._match_prefix(req)
-        self.prefilling = req
+        self._prefills.append(req)
         self.recorder.record(
             "serve/prefill_start", rid=req.rid, slot=slot,
             chunks=len(req.chunks), cached_chunks=req.cached_chunks,
         )
         return req
 
-    def take_chunk(self, budget: int) -> Optional[Tuple[Request, int, int, int, bool]]:
+    @staticmethod
+    def _remaining_compute(req: Request) -> int:
+        """Tokens still needing a forward pass: cached chunks replay for
+        free, so they don't count toward shortest-remaining-first."""
+        skip = max(req.next_chunk, req.cached_chunks)
+        return sum(v for _, v in req.chunks[skip:])
+
+    def take_chunk(self, budget: int, ready=None,
+                   ) -> Optional[Tuple[Request, int, int, int, bool]]:
         """Next prefill chunk fitting ``budget``:
         ``(request, bucket_len, valid_len, start, cached)`` or None.
+
+        With several open prefills the pick is shortest-remaining-first
+        (remaining *compute* tokens; FCFS rid breaks ties) among those whose
+        next chunk fits the budget — a chat prompt's single chunk lands ahead
+        of a mega-prompt's hundredth without starving it (every candidate
+        stays eligible each step).  ``ready`` is an optional per-request
+        gate — the paged engine passes its page-reservation check, so a
+        request short on pages this step doesn't block a smaller one that
+        fits.
 
         A CACHED chunk (``cached=True``: covered by a pinned prefix-cache
         node) charges nothing against the budget — replaying retained KV is
         one ``dynamic_update_slice``, not a forward pass — so hits both skip
         compute and leave the whole budget to cold prompts this step.
+
+        The FIRST forward-pass chunk since :meth:`begin_step` ignores the
+        budget check: the joint decode+prefill bound may leave a remainder
+        smaller than the pending bucket every single cycle, and without this
+        carve-out such a chunk would starve until the pool idles.
         """
-        req = self.prefilling
-        if req is None or req.next_chunk >= len(req.chunks):
+        best = None
+        best_key = None
+        for req in self._prefills:
+            if req.next_chunk >= len(req.chunks):
+                continue
+            bucket, _ = req.chunks[req.next_chunk]
+            cached = req.next_chunk < req.cached_chunks
+            if not cached and bucket > budget and self._chunk_this_step:
+                continue
+            if ready is not None and not ready(req):
+                continue
+            key = (self._remaining_compute(req), req.rid)
+            if best_key is None or key < best_key:
+                best, best_key = req, key
+        if best is None:
             return None
-        bucket, valid = req.chunks[req.next_chunk]
-        cached = req.next_chunk < req.cached_chunks
-        if not cached and bucket > budget:
-            return None
-        start = sum(v for _, v in req.chunks[: req.next_chunk])
-        req.next_chunk += 1
-        return req, bucket, valid, start, cached
+        bucket, valid = best.chunks[best.next_chunk]
+        cached = best.next_chunk < best.cached_chunks
+        start = sum(v for _, v in best.chunks[: best.next_chunk])
+        best.next_chunk += 1
+        if not cached:
+            self._chunk_this_step = True
+        return best, bucket, valid, start, cached
 
     def finish_prefill(self) -> Optional[Request]:
-        """If the in-flight request has prefilled every chunk, hand it over
-        for insertion and clear the prefill lane."""
-        req = self.prefilling
-        if req is not None and req.next_chunk >= len(req.chunks):
-            self.prefilling = None
-            return req
+        """If an open prefill has run every chunk, hand it over for insertion
+        and clear its prefill lane (at most one per call — the engine installs
+        each finished request before taking the next chunk)."""
+        for i, req in enumerate(self._prefills):
+            if req.next_chunk >= len(req.chunks):
+                del self._prefills[i]
+                return req
         return None

@@ -1121,6 +1121,228 @@ def _kernel_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _prefill_ab_bench(args, model, cfg, params, preset):
+    """Flash-prefill kernel + decode-interleaved chunked prefill A/B.
+
+    The adversarial tenant mix the interleave exists for: one bulk tenant
+    streaming near-context-length prompts (the scaled stand-in for 100k-token
+    prompts) woven through chat traffic with heavy-tail log-normal output
+    lengths, every request labelled via ``request_class`` so the per-class
+    TTFT histograms split the two populations.  Three arms, same workload,
+    same page pool:
+
+    * **base** — non-interleaved, XLA gather/scatter prefill (the PR-6 path:
+      admit-then-decode, one open prefill at a time);
+    * **inter** — interleaved chunked prefill, XLA prefill program (chunks
+      dispatched behind the decode window, SRTF across open prefills, joint
+      per-cycle token budget);
+    * **flash** — interleaved + ``prefill_kernel="pallas"`` (the paged
+      flash-prefill kernel writing pages in place; interpreted off-TPU).
+
+    Hard checks, each a nonzero exit:
+
+    * greedy outputs of BOTH treatment arms token-identical to base — the
+      kernel swap and the dispatch reorder must be invisible in the tokens;
+    * ``compiled_executable_counts()`` identical across all three arms and
+      every watchdog within budget — the flash kernel REPLACES each
+      per-bucket prefill executable and the interleave only reorders
+      dispatch; neither may add a compiled shape;
+    * the treatment arms actually interleaved (``interleaved_chunks > 0``);
+    * chat-class p99 TTFT >= 1.3x better than base.  On TPU the gate runs
+      against the full treatment (flash); off-TPU against the XLA
+      interleaved arm — interpret-mode pallas prices a prefill chunk at
+      pure-Python cost, which would measure the interpreter, not the
+      interleave;
+    * on TPU only: flash-arm prefill tokens/s >= 0.9x the gather/scatter
+      base (off-TPU the interpreted kernel makes the ratio meaningless —
+      reported, not gated).
+
+    The headline metric is the chat p99 TTFT improvement (base over
+    treatment); prefill throughput and the bulk tenant's numbers ride in
+    ``detail``.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    window = args.decode_window
+    # small pages so a bulk prompt takes MANY chunk cycles — that is the
+    # window chat traffic must not be starved through
+    max_len = cfg.max_seq_len
+    page = max(4, max_len // 32)
+    buckets = (page, 2 * page)
+    max_len = (max_len // page) * page
+    pages_per_lane = max_len // page
+    mp = max_len - 2 * window  # longest admissible (bulk) prompt
+    slots = args.batch
+
+    # chat: short prompts (single chunk), heavy-tail log-normal outputs
+    r = np.random.default_rng(args.serve_seed)
+    n_chat = args.requests
+    chat_plens = np.clip(
+        np.rint(r.lognormal(np.log(max(3, page // 2)), 0.5, n_chat)), 2, page
+    ).astype(int)
+    out_cap = max_len - 2 * page - window
+    chat_olens = np.clip(
+        np.rint(r.lognormal(np.log(max(window, out_cap // 6)), 1.0, n_chat)),
+        window, out_cap,
+    ).astype(int)
+    # bulk: near-mp prompts, minimal outputs (the tenant streams prompts in)
+    n_bulk = max(2, n_chat // 8)
+    bulk_plens = r.integers(3 * mp // 4, mp + 1, n_bulk)
+
+    workload = []  # (prompt, config, class) in submission order
+    for i in range(n_chat):
+        workload.append((
+            r.integers(1, cfg.vocab_size, (int(chat_plens[i]),)).astype(np.int32),
+            GenerationConfig(max_new_tokens=int(chat_olens[i])),
+            "chat",
+        ))
+    # bulk requests woven in FIRST in each stripe: FCFS admission puts the
+    # long prefill ahead of the chat requests behind it — the starvation the
+    # interleave must break
+    stride = max(1, len(workload) // n_bulk)
+    for j in range(n_bulk):
+        workload.insert(j * (stride + 1), (
+            r.integers(1, cfg.vocab_size, (int(bulk_plens[j]),)).astype(np.int32),
+            GenerationConfig(max_new_tokens=window),
+            "bulk",
+        ))
+    useful_tokens = int(chat_olens.sum()) + n_bulk * window
+    roomy = slots * pages_per_lane + 1  # page pressure never binds
+
+    def run_arm(interleave, prefill_kernel):
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            model, params, num_slots=slots, max_len=max_len,
+            max_prompt_len=mp, prefill_buckets=buckets,
+            decode_window=window, registry=registry, prefix_cache_mb=0,
+            paged=True, page_size=page, num_pages=roomy,
+            prefill_kernel=prefill_kernel, interleave_prefill=interleave,
+        )
+        # warm every executable the timed serve dispatches, including the
+        # lane_install scatter (compiles only on an admission AFTER the
+        # first window — warm with more requests than slots)
+        warm = [r.integers(1, cfg.vocab_size, (buckets[0],)).astype(np.int32)
+                for _ in range(slots + 2)]
+        warm[:len(buckets)] = [
+            r.integers(1, cfg.vocab_size, (b,)).astype(np.int32) for b in buckets
+        ]
+        eng.serve(warm, GenerationConfig(max_new_tokens=window))
+        for k in eng.stats:
+            eng.stats[k] = 0
+        registry.reset()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, config=g, request_class=c) for p, g, c in workload]
+        eng.run()
+        dt = time.perf_counter() - t0
+        return eng, reqs, dt, registry
+
+    eng_b, reqs_b, dt_b, reg_b = run_arm(False, "xla")
+    eng_i, reqs_i, dt_i, reg_i = run_arm(True, "xla")
+    eng_f, reqs_f, dt_f, reg_f = run_arm(True, "pallas")
+
+    for name, reqs in (("interleaved", reqs_i), ("flash-prefill", reqs_f)):
+        if [q.tokens for q in reqs] != [q.tokens for q in reqs_b]:
+            raise SystemExit(
+                f"{name} arm changed greedy outputs: tokens differ from the "
+                "non-interleaved xla-prefill base arm on the same workload"
+            )
+    for name, eng in (("interleaved", eng_i), ("flash-prefill", eng_f)):
+        if eng.compiled_executable_counts() != eng_b.compiled_executable_counts():
+            raise SystemExit(
+                f"{name} arm changed the compiled-executable budget: "
+                f"{eng.compiled_executable_counts()} vs "
+                f"{eng_b.compiled_executable_counts()}"
+            )
+        if eng.stats["interleaved_chunks"] <= 0:
+            raise SystemExit(
+                f"{name} arm never interleaved a chunk behind a decode "
+                "window — the bench is not measuring interleaved prefill"
+            )
+        if any(f.over_budget() for f in eng._prefill.values()) or eng._decode.over_budget():
+            raise SystemExit(f"{name} arm blew a recompile-watchdog budget")
+
+    def klass_p99(reg, cls):
+        return reg.get(f"serve/ttft_s_class_{cls}").snapshot()["p99"]
+
+    ttft_base = klass_p99(reg_b, "chat")
+    ttft_inter = klass_p99(reg_i, "chat")
+    ttft_flash = klass_p99(reg_f, "chat")
+    # off-TPU the flash arm prices prefill chunks at interpret cost; gate the
+    # interleave on the kernel-equal arm there, the full treatment on TPU
+    gate_ttft = ttft_flash if on_tpu else ttft_inter
+    ttft_ratio = ttft_base / max(gate_ttft, 1e-9)
+    if ttft_ratio < 1.3:
+        raise SystemExit(
+            f"interleaved chunked prefill left chat p99 TTFT at "
+            f"{1e3 * gate_ttft:.1f}ms vs base {1e3 * ttft_base:.1f}ms "
+            f"({ttft_ratio:.2f}x; >= 1.3x required)"
+        )
+
+    pf_tps = {
+        "base": eng_b.stats["prefill_tokens"] / dt_b,
+        "inter": eng_i.stats["prefill_tokens"] / dt_i,
+        "flash": eng_f.stats["prefill_tokens"] / dt_f,
+    }
+    pf_ratio = pf_tps["flash"] / max(pf_tps["base"], 1e-9)
+    if on_tpu and pf_ratio < 0.9:
+        raise SystemExit(
+            f"flash prefill kernel slowed prefill throughput: "
+            f"{pf_tps['flash']:.1f} vs gather/scatter {pf_tps['base']:.1f} "
+            f"prompt tokens/s ({pf_ratio:.2f}x; >= 0.9x required)"
+        )
+
+    def arm_detail(eng, dt, reg):
+        snap = reg.snapshot()
+        out = {
+            "tokens_per_s": round(useful_tokens / dt, 2),
+            "wall_s": round(dt, 3),
+            "prefill_tokens_per_s": round(eng.stats["prefill_tokens"] / dt, 2),
+            "interleaved_chunks": eng.stats["interleaved_chunks"],
+            "prefill_chunks": eng.stats["prefill_chunks"],
+            "interleave_ratio": round(
+                float(snap.get("serve/prefill_interleave_ratio", 0.0)), 3),
+            "compiled_executables": eng.compiled_executable_counts(),
+        }
+        for cls in ("chat", "bulk"):
+            h = snap.get(f"serve/ttft_s_class_{cls}")
+            if h:
+                out[f"ttft_{cls}_p50_ms"] = round(1e3 * h["p50"], 2)
+                out[f"ttft_{cls}_p99_ms"] = round(1e3 * h["p99"], 2)
+        return out
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "chat_requests": n_chat,
+        "bulk_requests": n_bulk,
+        "num_slots": slots,
+        "decode_window": window,
+        "page_size": page,
+        "max_len": max_len,
+        "bulk_prompt_lens": [int(p) for p in bulk_plens],
+        "useful_tokens": useful_tokens,
+        "ttft_gate_arm": "flash" if on_tpu else "inter",
+        "chat_ttft_p99_ratio_inter": round(ttft_base / max(ttft_inter, 1e-9), 3),
+        "chat_ttft_p99_ratio_flash": round(ttft_base / max(ttft_flash, 1e-9), 3),
+        "prefill_tokens_per_s_ratio_flash": round(pf_ratio, 3),
+        "prefill_tps_gate": "hard" if on_tpu else "report-only (interpret)",
+        "base": arm_detail(eng_b, dt_b, reg_b),
+        "inter": arm_detail(eng_i, dt_i, reg_i),
+        "flash": arm_detail(eng_f, dt_f, reg_f),
+    }
+    return {
+        "metric": "serving_chat_ttft_p99_interleave_speedup",
+        "value": round(ttft_ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ttft_ratio, 3),
+        "detail": detail,
+    }
+
+
 def _http_ab_bench(args, model, cfg, params, preset):
     """Over-the-wire A/B of the OpenAI front door against the in-process engine.
 
@@ -1794,10 +2016,12 @@ def _serve_bench(args, model, cfg, params, preset):
             bool(getattr(args, "async_ab", False)),
             bool(getattr(args, "http_ab", False)),
             bool(getattr(args, "chaos_ab", False)),
+            bool(getattr(args, "prefill_ab", False)),
             bool(args.shared_prefix)]) > 1:
         raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab, "
-                         "--http-ab, --chaos-ab and --shared-prefix are "
-                         "separate serve workloads; pick one")
+                         "--http-ab, --chaos-ab, --prefill-ab and "
+                         "--shared-prefix are separate serve workloads; "
+                         "pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "http_ab", False):
@@ -1806,6 +2030,8 @@ def _serve_bench(args, model, cfg, params, preset):
         return _chaos_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "kernel_ab", False):
         return _kernel_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "prefill_ab", False):
+        return _prefill_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "tp_ab", False):
         return _tp_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "async_ab", False):
@@ -2031,6 +2257,14 @@ def main():
                              "driver crashes), then prove faults-off costs "
                              "nothing (<=1%% A/B, zero new executables; all "
                              "hard checks)")
+    parser.add_argument("--prefill-ab", dest="prefill_ab", action="store_true",
+                        help="--task serve: A/B the flash-prefill kernel and "
+                             "decode-interleaved chunked prefill against the "
+                             "admit-then-decode gather/scatter base on an "
+                             "adversarial long-prompt-tenant + chat mix — "
+                             "token-identity, executable-budget, and chat "
+                             "p99-TTFT >= 1.3x hard checks; prefill tokens/s "
+                             "gated on TPU")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",
                         help="--kernel-ab: quantized KV page format for the "

@@ -1,0 +1,30 @@
+"""jit-signature-drift (prefill executables): the per-bucket paged prefill
+dict fed call-varying shapes — three violations (chunk sliced by the
+prompt's drifting length, a pad constructor sized by it, the drifting
+length itself passed positionally).  The final call is the repo's actual
+idiom — bucket-padded chunk, subscript dispatch on the padded size — and
+must stay unflagged."""
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self, bucket, page_size):
+        self._prefill = {
+            bucket: _serve_jit(  # noqa: F821 — fixture stub
+                make_paged_prefill_chunk(bucket, page_size),  # noqa: F821
+            ),
+        }
+
+    def admit(self, params, chunk, kv, table, base):
+        n = len(chunk)
+        bad_slice = self._prefill[64](
+            params, chunk[:n], kv.pages_k, kv.pages_v, table, base)
+        bad_pad = self._prefill[64](
+            params, jnp.zeros(n, jnp.int32), kv.pages_k, kv.pages_v,
+            table, base)
+        bad_base = self._prefill[64](
+            params, chunk, kv.pages_k, kv.pages_v, table, n)
+        good = self._prefill[64](
+            params, pad_to_bucket(chunk, 64),  # noqa: F821 — fixture stub
+            kv.pages_k, kv.pages_v, table, base)
+        return bad_slice, bad_pad, bad_base, good

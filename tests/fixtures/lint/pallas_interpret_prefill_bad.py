@@ -1,0 +1,20 @@
+"""pallas-interpret (flash prefill): the scalar-prefetch ``pallas_call`` of
+the paged flash-prefill kernel without ``interpret=`` — one violation.
+Minimized from ``accelerate_tpu/ops/paged_attention.py::paged_flash_prefill``:
+hard-coding compiled mode here would break the CPU parity oracle
+(``tests/test_paged_attention.py``) the kernel is tested against."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def flash_prefill(kernel, tables, lengths, qf, pages_k, pages_v, grid,
+                  in_specs, out_specs, out_shape):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=grid, in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((8, 128), jax.numpy.float32)],
+    )
+    return pl.pallas_call(kernel, grid_spec=grid_spec, out_shape=out_shape)(
+        tables, lengths, qf, pages_k, pages_v
+    )

@@ -1,0 +1,21 @@
+"""pallas-interpret (flash prefill) clean: the same scalar-prefetch
+``pallas_call`` threading the caller's ``interpret`` flag with the
+``_default_interpret()`` off-TPU autodetection default — the repo
+convention every kernel entry point follows."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def flash_prefill(kernel, tables, lengths, qf, pages_k, pages_v, grid,
+                  in_specs, out_specs, out_shape, interpret=None):
+    if interpret is None:
+        interpret = _default_interpret()  # noqa: F821 — fixture stub
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=grid, in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((8, 128), jax.numpy.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret,
+    )(tables, lengths, qf, pages_k, pages_v)

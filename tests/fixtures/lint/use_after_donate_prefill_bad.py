@@ -1,0 +1,26 @@
+"""use-after-donate (direct prefill chunk): minimized from
+``accelerate_tpu/serving/engine.py::_paged_prefill_chunk`` with the
+deferred quant-error discipline reverted.  The direct prefill executable
+donates the page pool AND the per-page scales (positions 2..5); reading the
+old ``kv.k_scales`` handle after dispatch — e.g. to publish a quantization
+gauge — sees freed memory.  The fix the engine ships is to read only the
+RETURNED handles and defer the error fetch to the window drain.  One
+violation, on the gauge line."""
+
+
+class Engine:
+    def __init__(self, bucket, page_size):
+        self._prefill_8 = _serve_jit(  # noqa: F821 — fixture stub
+            make_direct_prefill_chunk(bucket, page_size),  # noqa: F821
+            donate_argnums=(2, 3, 4, 5),
+        )
+
+    def prefill_chunk(self, params, chunk, kv, table, base):
+        new_k, new_v, new_ks, new_vs, qerr = self._prefill_8(
+            params, chunk[None], kv.pages_k, kv.pages_v,
+            kv.k_scales, kv.v_scales, table, base,
+        )
+        self._kv_quant_gauge.set(float(kv.k_scales.max()))
+        kv.pages_k, kv.pages_v = new_k, new_v
+        kv.k_scales, kv.v_scales = new_ks, new_vs
+        return qerr

@@ -110,6 +110,41 @@ def test_use_after_donate_clean_parked_and_drained():
     assert report.diagnostics == [], [d.render() for d in report.diagnostics]
 
 
+# ------------------------------------ flash-prefill / interleave fixtures
+
+def test_pallas_interpret_flash_prefill_golden():
+    """The scalar-prefetch pallas_call shape of paged_flash_prefill: missing
+    interpret= fires; threading the _default_interpret() convention is
+    clean."""
+    report = run_rules(["pallas-interpret"], ["pallas_interpret_prefill_bad.py"])
+    assert len(report.diagnostics) == 1, [d.render() for d in report.diagnostics]
+    assert report.diagnostics[0].rule == "pallas-interpret"
+    report = run_rules(["pallas-interpret"], ["pallas_interpret_prefill_clean.py"])
+    assert report.diagnostics == [], [d.render() for d in report.diagnostics]
+
+
+def test_use_after_donate_prefill_scales_read():
+    """The direct prefill chunk donates pages AND per-page scales: reading
+    the old scales handle after dispatch (the reverted deferred-qerr
+    discipline) is a read-after-donate."""
+    report = run_rules(["use-after-donate"], ["use_after_donate_prefill_bad.py"])
+    assert len(report.diagnostics) == 1, [d.render() for d in report.diagnostics]
+    d = report.diagnostics[0]
+    assert "'kv.k_scales' was donated" in d.message and "read here" in d.message
+
+
+def test_jit_signature_drift_prefill_executables():
+    """The per-bucket prefill dict fed call-varying shapes fires three ways;
+    the bucket-padded dispatch idiom stays unflagged."""
+    report = run_rules(["jit-signature-drift"],
+                       ["jit_signature_drift_prefill_bad.py"])
+    assert len(report.diagnostics) == 3, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "sliced by a call-varying bound" in msgs
+    assert "zeros(...) sized by a call-varying" in msgs
+    assert "passed positionally" in msgs
+
+
 def test_metric_docs_both_directions():
     root = FIX / "metric_docs_proj"
     report = run_rules(["metric-docs"], ["pkg"], root=root)

@@ -190,6 +190,7 @@ def _settle(predicate, timeout=60.0):
 def test_replica_kill_mid_decode_loses_nothing(svc):
     n = 6
     results = [None] * n
+    submitted_before = svc.stat("requests_submitted")
 
     def fire(k):
         results[k] = svc.completion(svc.prompts[k % len(svc.prompts)])
@@ -197,6 +198,16 @@ def test_replica_kill_mid_decode_loses_nothing(svc):
     threads = [threading.Thread(target=fire, args=(k,)) for k in range(n)]
     for t in threads:
         t.start()
+    # every submit must be ACCEPTED before the kill: the kill runs on this
+    # thread, not through the driver ticket queue, so on a loaded host it can
+    # otherwise land between submits — and a straggler then finds the lone
+    # survivor holding the victim's replayed lanes with a full queue (429).
+    # Any 3/3..6/0 split of 6 accepted requests fits the survivor's
+    # 2 slots + 4 queue after replay, so waiting makes the test deterministic.
+    assert _settle(
+        lambda: svc.stat("requests_submitted") - submitted_before >= n,
+        timeout=30.0,
+    ), "not every request was admitted"
     # the victim must genuinely own work when it dies, or the test shows
     # nothing: least-loaded routing spreads 6 requests across 2 replicas
     assert _settle(lambda: svc.e2.has_work, timeout=30.0), \

@@ -27,8 +27,11 @@ from accelerate_tpu.ops.paged_attention import (
     kv_storage_dtype,
     paged_attention,
     paged_attention_reference,
+    paged_flash_prefill,
+    paged_flash_prefill_reference,
     paged_insert,
     paged_quantized_insert,
+    resolve_paged_kernel,
 )
 from accelerate_tpu.serving import NULL_PAGE, ServingEngine
 from accelerate_tpu.telemetry import MetricsRegistry
@@ -137,6 +140,134 @@ class TestKernelParity:
         with pytest.raises(ValueError):
             paged_attention(q, pk.astype(jnp.int8), pv.astype(jnp.int8),
                             tables, lengths)
+
+
+class TestFlashPrefillParity:
+    """paged_flash_prefill (interpret mode) vs the pure-XLA oracle: the
+    causal flash kernel over pool pages must agree with the reference on
+    every chunk shape the engine can dispatch — mid-prompt chunks attending
+    prior pages, first chunks with no history, ragged tails, GQA folds, and
+    quantized pages."""
+
+    @pytest.mark.parametrize(
+        "n,s,page,pages_per_lane,hkv,rep,d",
+        [
+            (1, 8, 8, 4, 2, 1, 16),    # one chunk == one page, MHA
+            (2, 16, 8, 6, 2, 2, 32),   # chunk spans pages, GQA fold
+            (2, 8, 8, 5, 1, 4, 64),    # wide GQA group, bigger head
+            (3, 4, 16, 3, 2, 1, 16),   # chunk smaller than a page
+        ],
+    )
+    def test_matches_reference(self, n, s, page, pages_per_lane, hkv, rep, d):
+        rng = np.random.default_rng(hash(("pf", n, s, page, rep, d)) % 2**32)
+        q, pk, pv, tables, lengths = _scenario(
+            rng, n, s, page, pages_per_lane, hkv, rep, d
+        )
+        ref = paged_flash_prefill_reference(q, pk, pv, tables, lengths)
+        out = paged_flash_prefill(q, pk, pv, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_causal_mask_at_chunk_boundary(self):
+        """A later chunk's rows must see ALL prior-chunk history plus only
+        their own causal prefix: shifting a token the chunk should not see
+        (a future in-chunk position) must leave earlier rows unchanged,
+        while shifting history must change them."""
+        rng = np.random.default_rng(31)
+        page, s = 8, 8
+        q, pk, pv, tables, _ = _scenario(rng, 1, s, page, 5, 2, 2, 16)
+        # mid-prompt: pin 13 tokens of history (all within mapped pages, so
+        # any zero tail just attends zeros — determinism is what's probed)
+        lengths = jnp.asarray([13])
+        out = np.asarray(paged_flash_prefill(q, pk, pv, tables, lengths))
+        # poke the KV at the chunk's LAST position (13 + s - 1): only the
+        # final query row may change
+        pk2, pv2 = np.asarray(pk).copy(), np.asarray(pv).copy()
+        t = 13 + s - 1
+        pk2[int(tables[0, t // page]), t % page] += 3.0
+        out2 = np.asarray(paged_flash_prefill(
+            q, jnp.asarray(pk2), jnp.asarray(pv2), tables, lengths
+        ))
+        np.testing.assert_allclose(out2[:, :-1], out[:, :-1], atol=2e-5)
+        assert not np.allclose(out2[:, -1], out[:, -1], atol=1e-4)
+        # poke history (position 3): EVERY row must change (softmax weights)
+        pk3 = np.asarray(pk).copy()
+        pk3[int(tables[0, 3 // page]), 3 % page] += 3.0
+        out3 = np.asarray(paged_flash_prefill(
+            q, jnp.asarray(pk3), pv, tables, lengths
+        ))
+        assert not np.allclose(out3[:, 0], out[:, 0], atol=1e-4)
+
+    def test_ragged_final_chunk_and_dead_pages(self):
+        """Pages past each lane's causal frontier are never read: poisoning
+        them must not perturb a single output element (the page-skip bound
+        subsumes the dead-page check)."""
+        rng = np.random.default_rng(33)
+        n, s, page, ppl = 3, 8, 8, 6
+        q, pk, pv, tables, lengths = _scenario(rng, n, s, page, ppl, 2, 2, 16)
+        out = paged_flash_prefill(q, pk, pv, tables, lengths)
+        live = (np.asarray(lengths) + s - 1) // page + 1
+        pk_p, pv_p = np.asarray(pk).copy(), np.asarray(pv).copy()
+        for lane in range(n):
+            for slot in range(int(live[lane]), ppl):
+                pk_p[int(tables[lane, slot])] = 1e9
+                pv_p[int(tables[lane, slot])] = 1e9
+        out_p = paged_flash_prefill(
+            q, jnp.asarray(pk_p), jnp.asarray(pv_p), tables, lengths
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_p))
+
+    def test_bf16_matches_reference(self):
+        rng = np.random.default_rng(34)
+        q, pk, pv, tables, lengths = _scenario(
+            rng, 2, 8, 8, 5, 2, 2, 32, dtype=jnp.bfloat16
+        )
+        ref = paged_flash_prefill_reference(q, pk, pv, tables, lengths)
+        out = paged_flash_prefill(q, pk, pv, tables, lengths)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+        )
+
+    @pytest.mark.parametrize("fmt", ["int8", "fp8"])
+    def test_quantized_pages_match_reference(self, fmt):
+        dtype, _ = KV_FORMATS[fmt]
+        rng = np.random.default_rng(35)
+        q, pk, pv, tables, lengths = _scenario(rng, 2, 8, 8, 5, 2, 2, 16)
+        num_pages, _, hkv, _ = pk.shape
+        qk = jnp.asarray(
+            rng.integers(-100, 101, pk.shape).astype(np.float32)
+        ).astype(dtype)
+        qv = jnp.asarray(
+            rng.integers(-100, 101, pv.shape).astype(np.float32)
+        ).astype(dtype)
+        ks = jnp.asarray(rng.uniform(0.01, 0.1, (num_pages, hkv)).astype(np.float32))
+        vs = jnp.asarray(rng.uniform(0.01, 0.1, (num_pages, hkv)).astype(np.float32))
+        ref = paged_flash_prefill_reference(q, qk, qv, tables, lengths,
+                                            k_scales=ks, v_scales=vs)
+        out = paged_flash_prefill(q, qk, qv, tables, lengths,
+                                  k_scales=ks, v_scales=vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_quantized_without_scales_rejected(self):
+        rng = np.random.default_rng(36)
+        q, pk, pv, tables, lengths = _scenario(rng, 1, 8, 8, 3, 1, 1, 16)
+        with pytest.raises(ValueError):
+            paged_flash_prefill(q, pk.astype(jnp.int8), pv.astype(jnp.int8),
+                                tables, lengths)
+
+
+class TestResolvePrefillKernel:
+    def test_prefill_role_falls_back_under_tp(self):
+        class FakeMesh:
+            shape = {"tp": 2}
+            axis_names = ("tp",)
+        assert resolve_paged_kernel("pallas", FakeMesh(), "tp",
+                                    role="prefill") == "xla"
+        assert resolve_paged_kernel("pallas", None, "tp", role="prefill") == "pallas"
+        assert resolve_paged_kernel("xla", FakeMesh(), "tp", role="prefill") == "xla"
+
+    def test_unknown_role_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_paged_kernel("pallas", None, "tp", role="train")
 
 
 class TestPagedInsert:

@@ -730,3 +730,162 @@ class TestSpeculative:
         with pytest.raises(ValueError, match="speculate_k"):
             eng.submit(np.ones(8, np.int32), max_new_tokens=49)
         eng.submit(np.ones(8, np.int32), max_new_tokens=48)
+
+
+class TestInterleavedPrefill:
+    """Decode-interleaved chunked prefill must be invisible in the token
+    streams: dispatching a prompt's chunks behind the same cycle's decode
+    window (instead of ahead of it) reorders device work, never outputs —
+    lane RNG streams are keyed by request id, not arrival cycle."""
+
+    def _workload(self, model, seed=40, lens=(3, 14, 5, 22, 9)):
+        rng = np.random.default_rng(seed)
+        return _prompts(rng, lens, model.config.vocab_size)
+
+    def _serve(self, model, params, prompts, gen, **kw):
+        defaults = dict(paged=True, page_size=4, async_depth=1)
+        defaults.update(kw)
+        eng = _engine(model, params, **defaults)
+        reqs = eng.serve([p.copy() for p in prompts], configs=gen)
+        return eng, [r.tokens for r in reqs]
+
+    def test_greedy_identical_and_chunks_interleave(self):
+        model, params = _tiny_model()
+        prompts = self._workload(model)
+        gen = GenerationConfig(max_new_tokens=8, do_sample=False, eos_token_id=None)
+        _, base = self._serve(model, params, prompts, gen)
+        eng, inter = self._serve(model, params, prompts, gen,
+                                 interleave_prefill=True)
+        assert inter == base
+        for toks, prompt in zip(base, prompts):
+            assert toks == _expected(model, params, prompt, gen)
+        # the mix is wide enough that some chunks really did ride behind a
+        # decode window — the property the knob exists for
+        assert eng.stats["interleaved_chunks"] > 0
+        assert eng.stats["interleaved_chunks"] <= eng.stats["prefill_chunks"]
+
+    def test_sampled_identical(self):
+        model, params = _tiny_model()
+        prompts = self._workload(model, seed=41)
+        gen = GenerationConfig(max_new_tokens=6, do_sample=True,
+                               temperature=0.8, top_k=50, eos_token_id=None)
+        _, base = self._serve(model, params, prompts, gen)
+        _, inter = self._serve(model, params, prompts, gen,
+                               interleave_prefill=True)
+        assert inter == base
+
+    def test_speculative_identical(self):
+        model, params = _tiny_model()
+        base_p = np.tile(np.array([5, 6, 7], np.int32), 8)
+        prompts = [base_p[:9], base_p[:18], base_p[:9], base_p[:21]]
+        gen = GenerationConfig(max_new_tokens=8, do_sample=False, eos_token_id=None)
+        _, base = self._serve(model, params, prompts, gen, speculate_k=2)
+        eng, inter = self._serve(model, params, prompts, gen, speculate_k=2,
+                                 interleave_prefill=True)
+        assert inter == base
+        assert eng.stats["spec_accepted"] > 0
+
+    @pytest.mark.parametrize("prefill_kernel", ["xla", "pallas"])
+    def test_flash_prefill_identical(self, prefill_kernel):
+        """prefill_kernel="pallas" (the paged flash-prefill kernel, interpret
+        mode on CPU) + interleaving vs the default gather/scatter ordering."""
+        model, params = _tiny_model()
+        prompts = self._workload(model, seed=42)
+        gen = GenerationConfig(max_new_tokens=8, do_sample=False, eos_token_id=None)
+        _, base = self._serve(model, params, prompts, gen)
+        _, out = self._serve(model, params, prompts, gen,
+                             interleave_prefill=True,
+                             prefill_kernel=prefill_kernel)
+        assert out == base
+
+    @pytest.mark.parametrize("fmt", ["int8", "fp8"])
+    def test_quantized_flash_prefill_identical(self, fmt):
+        """Quantized pages: interleaved flash prefill must match the
+        non-interleaved quantized engine exactly — chunks quantize at scatter
+        time with the same per-page scales either way."""
+        model, params = _tiny_model()
+        prompts = self._workload(model, seed=43)
+        gen = GenerationConfig(max_new_tokens=6, do_sample=False, eos_token_id=None)
+        _, base = self._serve(model, params, prompts, gen, kv_dtype=fmt,
+                              decode_kernel="pallas")
+        _, out = self._serve(model, params, prompts, gen, kv_dtype=fmt,
+                             decode_kernel="pallas", prefill_kernel="pallas",
+                             interleave_prefill=True)
+        assert out == base
+
+    def test_prefix_cache_hits_stay_exact_under_interleave(self):
+        """Cached chunks alias pages (zero budget, no forward pass); the
+        interleaved scheduler must replay them identically and still count
+        hits — SRTF ordering cannot skip or double-play a cached chunk."""
+        model, params = _tiny_model()
+        rng = np.random.default_rng(44)
+        vocab = model.config.vocab_size
+        shared = rng.integers(1, vocab, (8,)).astype(np.int32)
+        warm = [np.concatenate([shared, s]) for s in _prompts(rng, [3, 5, 2], vocab)]
+        cold = _prompts(rng, [5, 14], vocab)
+        prompts = [warm[0], cold[0], warm[1], cold[1], warm[2]]
+        gen = GenerationConfig(max_new_tokens=6, do_sample=False, eos_token_id=None)
+        _, base = self._serve(model, params, prompts, gen, prefix_cache_mb=16)
+        eng, inter = self._serve(model, params, prompts, gen, prefix_cache_mb=16,
+                                 interleave_prefill=True)
+        assert inter == base
+        assert eng.stats["prefix_hit_tokens"] == 16
+        assert (eng.stats["prefix_hit_tokens"] + eng.stats["prefix_miss_tokens"]
+                == eng.stats["prefill_tokens"])
+
+    def test_prefill_kernel_validation(self):
+        model, params = _tiny_model()
+        with pytest.raises(ValueError):
+            _engine(model, params, paged=True, prefill_kernel="mosaic")
+        with pytest.raises(ValueError):
+            _engine(model, params, paged=False, prefill_kernel="pallas")
+        with pytest.raises(ValueError):
+            _engine(model, params, paged=False, interleave_prefill=True)
+
+    def test_prefill_kernel_follows_decode_kernel_by_default(self):
+        model, params = _tiny_model()
+        eng = _engine(model, params, paged=True, decode_kernel="pallas")
+        assert eng.prefill_kernel == "pallas"
+        eng = _engine(model, params, paged=True)
+        assert eng.prefill_kernel == "xla"
+        eng = _engine(model, params, paged=True, decode_kernel="pallas",
+                      prefill_kernel="xla")
+        assert eng.prefill_kernel == "xla"
+
+    def test_interleave_metrics_flow_through_registry(self):
+        model, params = _tiny_model()
+        prompts = self._workload(model, seed=45)
+        gen = GenerationConfig(max_new_tokens=8, do_sample=False, eos_token_id=None)
+        reg = MetricsRegistry()
+        eng = _engine(model, params, paged=True, page_size=4, async_depth=1,
+                      interleave_prefill=True, registry=reg)
+        reqs = [eng.submit(p, config=gen,
+                           request_class="chat" if i % 2 else "bulk")
+                for i, p in enumerate(prompts)]
+        eng.run()
+        snap = reg.snapshot()
+        assert snap["serve/interleaved_chunks_total"] == eng.stats["interleaved_chunks"]
+        assert 0.0 <= snap["serve/prefill_interleave_ratio"] <= 1.0
+        assert snap["serve/prefill_tokens_per_s"] > 0.0
+        # per-class TTFT histograms: every request observed exactly once
+        chat = snap["serve/ttft_s_class_chat"]
+        bulk = snap["serve/ttft_s_class_bulk"]
+        assert chat["count"] + bulk["count"] == len(reqs)
+        assert chat["count"] == sum(1 for i in range(len(prompts)) if i % 2)
+
+    def test_compiled_budget_flat_across_orderings(self):
+        """Interleaving reorders dispatch of executables that already exist;
+        the flash-prefill kernel replaces each bucket's program.  No arm may
+        add a compiled shape."""
+        if not jit_cache_supported():
+            pytest.skip("this jax hides the pjit executable-cache counter")
+        model, params = _tiny_model()
+        prompts = self._workload(model, seed=46)
+        gen = GenerationConfig(max_new_tokens=4, do_sample=False, eos_token_id=None)
+        counts = []
+        for kw in (dict(), dict(interleave_prefill=True),
+                   dict(interleave_prefill=True, prefill_kernel="pallas")):
+            eng, _ = self._serve(model, params, prompts, gen, **kw)
+            counts.append(eng.compiled_executable_counts())
+            assert not eng._prefill[4].over_budget()
+        assert counts[0] == counts[1] == counts[2]

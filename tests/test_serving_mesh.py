@@ -146,6 +146,18 @@ class TestTokenIdentity:
         assert t1 == t2
         assert e2.kv_pool_bytes() * 2 == e1.kv_pool_bytes()
 
+    def test_interleaved_flash_prefill_falls_back_and_matches(self):
+        """prefill_kernel="pallas" under tp=2 resolves to the XLA prefill arm
+        (the flash kernel is single-chip) and the interleaved ordering stays
+        token-identical to the unsharded, non-interleaved engine."""
+        model, params = _tiny_model()
+        gen = GenerationConfig(max_new_tokens=12, do_sample=False)
+        t1, _ = self._serve(model, params, gen, None, paged=True)
+        t2, e2 = self._serve(model, params, gen, _mesh_tp2(), paged=True,
+                             prefill_kernel="pallas", interleave_prefill=True)
+        assert t1 == t2
+        assert e2.prefill_kernel == "xla"
+
 
 class TestReplicaMeshes:
     def test_disjoint_slices(self):
