@@ -52,6 +52,7 @@ bench-serve:
 	python bench_inference.py --task serve --paged-ab
 	python bench_inference.py --task serve --kernel-ab
 	python bench_inference.py --task serve --prefill-ab
+	python bench_inference.py --task serve --hier-ab
 	python bench_inference.py --task serve --tp-ab
 	python bench_inference.py --task serve --async-ab
 	python bench_inference.py --task serve --http-ab

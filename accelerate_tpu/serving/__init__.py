@@ -20,6 +20,8 @@ from .pool import (
     make_paged_prefill_chunk,
     make_paged_verify_window,
     make_prefill_chunk,
+    make_promote_install,
+    make_spill_extract,
     make_verify_window,
     plan_chunks,
 )
@@ -56,6 +58,8 @@ __all__ = [
     "make_paged_verify_window",
     "make_paged_prefill_chunk",
     "make_copy_page",
+    "make_spill_extract",
+    "make_promote_install",
     "propose_ngram_draft",
     "jit_cache_sizes",
 ]

@@ -74,6 +74,8 @@ from .pool import (
     make_paged_prefill_chunk,
     make_paged_verify_window,
     make_prefill_chunk,
+    make_promote_install,
+    make_spill_extract,
     make_verify_window,
     plan_chunks,
 )
@@ -114,6 +116,17 @@ class ServingEngine:
     prefix_cache_mb: byte budget (MiB) for the chunk-granular prefix KV cache
         (:mod:`.prefix_cache`); ``0``/``None`` disables it.  Requests opt out
         per-request via ``submit(..., cache_prefix=False)``.
+    prefix_host_mb: byte budget (MiB) for the host-RAM spill tier behind the
+        device prefix cache (paged mode only).  Device-tier evictions demote
+        their pages host-side via an async D2H gather instead of dropping
+        them; a later hit on a spilled prefix promotes it back with an H2D
+        scatter-install enqueued BEHIND the in-flight decode window, charging
+        zero prefill budget.  ``0`` (the default) disables the tier and keeps
+        every existing code path byte-identical.
+    prefix_disk_mb: optional disk ring (MiB) behind the host tier; host-tier
+        evictions of landed payloads park as ``.npz`` files instead of
+        dropping.  Requires ``prefix_host_mb > 0`` and ``prefix_disk_dir``.
+    prefix_disk_dir: directory for the disk ring's page files.
     speculate_k: draft length K for self-speculative decoding; ``0`` (the
         default) disables it.  Cycles where at least one lane has an n-gram
         draft run one verify forward over ``[slots, K+1]`` positions instead
@@ -264,6 +277,9 @@ class ServingEngine:
         slot_order: Optional[Sequence[int]] = None,
         registry: Optional[MetricsRegistry] = None,
         prefix_cache_mb: Optional[float] = 64.0,
+        prefix_host_mb: Optional[float] = 0.0,
+        prefix_disk_mb: Optional[float] = 0.0,
+        prefix_disk_dir: Optional[str] = None,
         metrics_port: Optional[int] = None,
         speculate_k: int = 0,
         speculate_ngram: int = 3,
@@ -561,10 +577,47 @@ class ServingEngine:
             if self.paged
             else None
         )
+        self.prefix_host_bytes = int((prefix_host_mb or 0.0) * 2**20)
+        prefix_disk_bytes = int((prefix_disk_mb or 0.0) * 2**20)
+        if self.prefix_host_bytes and not (self.paged and prefix_cache_mb):
+            raise ValueError(
+                "prefix_host_mb spills prefix *pages*; it requires paged=True "
+                "and an enabled prefix cache (prefix_cache_mb > 0)"
+            )
+        if prefix_disk_bytes and not self.prefix_host_bytes:
+            raise ValueError(
+                "prefix_disk_mb sits behind the host ring; set prefix_host_mb"
+            )
+        if self.prefix_host_bytes:
+            # one D2H gather + one H2D scatter-install shape per prefill
+            # bucket: the documented compiled-budget growth of the host tier
+            self._spill_extract = {
+                b: RecompileWatchdog(
+                    make_spill_extract(b // self.page_size,
+                                       shardings=self._shardings),
+                    name=f"serve/spill_{b}", budget=1, registry=self.metrics,
+                )
+                for b in self.buckets
+            }
+            self._promote_install = {
+                b: RecompileWatchdog(
+                    make_promote_install(b // self.page_size,
+                                         shardings=self._shardings),
+                    name=f"serve/promote_{b}", budget=1, registry=self.metrics,
+                )
+                for b in self.buckets
+            }
+        else:
+            self._spill_extract = {}
+            self._promote_install = {}
         if prefix_cache_mb:
             self.prefix_cache: Optional[PrefixCache] = PrefixCache(
                 int(prefix_cache_mb * 2**20), registry=self.metrics,
                 on_evict=self._on_prefix_evict if self.paged else None,
+                host_capacity_bytes=self.prefix_host_bytes,
+                spill=self._spill_node if self.prefix_host_bytes else None,
+                disk_capacity_bytes=prefix_disk_bytes,
+                disk_dir=prefix_disk_dir,
             )
             # paged hits alias pages through the block table — no copy
             # executables exist; legacy replays slabs through one
@@ -644,6 +697,7 @@ class ServingEngine:
             "occupied_lane_steps": 0,
             "slots_reused": 0,
             "prefix_hit_tokens": 0,
+            "prefix_hit_tokens_host": 0,
             "prefix_miss_tokens": 0,
             "cancelled": 0,
             "spec_drafted": 0,
@@ -678,6 +732,17 @@ class ServingEngine:
         self._hit_rate_gauge = self.metrics.gauge(
             "serve/prefix_hit_rate",
             help="prefix_hit_tokens / (hit + miss) over cache-eligible prefill",
+        )
+        self._hit_rate_device_gauge = self.metrics.gauge(
+            "serve/prefix_hit_rate_device",
+            help="device-tier share of the prefix hit rate: tokens served by "
+                 "zero-copy page aliasing / (hit + miss)",
+        )
+        self._hit_rate_host_gauge = self.metrics.gauge(
+            "serve/prefix_hit_rate_host",
+            help="spilled-tier share of the prefix hit rate: tokens served by "
+                 "host/disk promotion (H2D install, no prefill FLOPs) / "
+                 "(hit + miss)",
         )
         self._decode_flops_gauge = self.metrics.gauge(
             "serve/decode_flops_per_token",
@@ -721,6 +786,12 @@ class ServingEngine:
         # attach to the next dispatched window's Readback and are folded into
         # the quant-error gauge at drain (fetching here would sync the pipe)
         self._pending_prefill_qerr: List = []
+        # hierarchical prefix cache deferrals, same discipline: spill gathers
+        # enqueued at eviction time (``(node, handles)``) land their payloads
+        # at the next drain; promotion-install records are acknowledged there.
+        # Fetching either eagerly would sync the pipeline mid-cycle.
+        self._pending_spills: List = []
+        self._pending_promotions: List = []
         # tokens charged by the decode window dispatched this cycle; _admit
         # subtracts it from the scheduler's joint per-cycle budget when the
         # interleaved ordering dispatched decode first
@@ -1158,6 +1229,18 @@ class ServingEngine:
                 hd.settle(self.kv.allocator)
         self._stale_handles.clear()
         self._pending_prefill_qerr.clear()
+        try:
+            self._settle_spills(self._pending_spills)
+        except Exception as exc:
+            # the gathers rode the poisoned dispatch stream: their payloads
+            # can't be trusted, so the nodes drop instead of staying spilled
+            self.recorder.record("serve/revive_spill_failed", error=repr(exc))
+            if self.prefix_cache is not None:
+                for node, handles in self._pending_spills:
+                    if node.host is handles:
+                        self.prefix_cache.discard_spilled(node)
+        self._pending_spills = []
+        self._pending_promotions = []
         self._cycle_decode_tokens = 0
         for s in range(self.num_slots):
             if self._active[s] or self._slot_req[s] is not None:
@@ -1277,10 +1360,23 @@ class ServingEngine:
             ptoks = req.prefill_tokens
             if cached:
                 node = req.cache_nodes[req.next_chunk - 1]
-                if self.paged:
-                    # the zero-copy hit: alias the node's physical pages into
-                    # this lane's block table — no device work at all
-                    self.kv.lane_append_shared(req.slot, node.pages)
+                spilled = self.paged and node.tier != "device"
+                if spilled and not self._promote_node(req, node, bucket):
+                    # degraded promotion (fault, page pressure, or a torn
+                    # payload): fall through to a plain cache miss — the chunk
+                    # re-prefills below, charging budget, and _populate_cache
+                    # heals the node with the fresh pages.  Token-identical:
+                    # the lane's KV is recomputed, never partially installed.
+                    cached = False
+                    self.recorder.record(
+                        "serve/promote_degraded", rid=req.rid, bucket=bucket,
+                        step=self._step_count,
+                    )
+                elif self.paged:
+                    if not spilled:
+                        # the zero-copy hit: alias the node's physical pages
+                        # into this lane's block table — no device work at all
+                        self.kv.lane_append_shared(req.slot, node.pages)
                 else:
                     # replay the retained slab: one dynamic_update_slice at the
                     # scratch index, zero budget charged (no forward pass ran)
@@ -1290,8 +1386,11 @@ class ServingEngine:
                     )
                     with self.tracer.span("serve/copy_chunk", bucket=bucket, start=start):
                         self.scratch = self._copy[bucket](self.scratch, node.k, node.v)
-                self._bump("prefix_hit_tokens", valid)
-            else:
+                if cached:
+                    self._bump("prefix_hit_tokens", valid)
+                    if spilled:
+                        self._bump("prefix_hit_tokens_host", valid)
+            if not cached:
                 chunk = np.zeros(bucket, np.int32)
                 chunk[:valid] = ptoks[start:start + valid]
                 if self.paged:
@@ -1321,9 +1420,132 @@ class ServingEngine:
     def _on_prefix_evict(self, node) -> None:
         """Prefix-cache eviction hook (paged mode): drop the cache's allocator
         reference on each retained page.  Pages still aliased by running lanes
-        survive; unreferenced ones return to the free list."""
+        survive; unreferenced ones return to the free list.  Spilled nodes
+        arrive here with ``pages = None`` — their refs were already dropped at
+        demotion time by :meth:`_spill_node`."""
         if node.pages:
             self.kv.allocator.deref(node.pages)
+
+    # ----------------------------------------------------- hierarchical cache
+    def _spill_node(self, node):
+        """PrefixCache ``spill`` hook: demote a device-tier node into the
+        host ring.  Enqueues the bucket's D2H page gather and releases the
+        cache's page refs immediately — the device executes in dispatch
+        order, so any later prefill recycling those pages is ordered BEHIND
+        the gather and the extracted payload is exact.  Nothing blocks here:
+        the gather's device handles become the node's interim payload and the
+        actual host copy lands at the next drain (``Readback.spills``).
+        Returns ``None`` (node drops instead) when the node's page count
+        matches no prefill bucket."""
+        bucket = len(node.pages) * self.page_size
+        if bucket not in self._spill_extract:
+            return None
+        kv = self.kv
+        ids = self._put(np.asarray(node.pages, np.int32))
+        with self.tracer.span("serve/spill_d2h", bucket=bucket):
+            handles = self._spill_extract[bucket](
+                kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, ids,
+            )
+        self.kv.allocator.deref(node.pages)
+        self._pending_spills.append((node, handles))
+        self.recorder.record(
+            "serve/spill", bucket=bucket, step=self._step_count,
+            behind_window=self._inflight is not None
+            or self._prev_handle is not None,
+        )
+        return handles
+
+    def _put_kv_chunk(self, x: np.ndarray):
+        """Upload one spilled chunk's page data with the pool's placement
+        (head-axis sharded under a mesh, so the promote install's donated
+        in-place aliasing holds per shard)."""
+        if self._shardings is not None:
+            return jax.device_put(np.ascontiguousarray(x), self._shardings.kv)
+        return jnp.asarray(x)
+
+    def _put_scale_chunk(self, x: np.ndarray):
+        if self._shardings is not None:
+            return jax.device_put(
+                np.ascontiguousarray(x), self._shardings.scales
+            )
+        return jnp.asarray(x)
+
+    def _promote_node(self, req: Request, node, bucket: int) -> bool:
+        """Promote one spilled prefix chunk host -> device for ``req``:
+        allocate fresh pages, upload the payload, and enqueue the
+        scatter-install BEHIND the in-flight decode window — the depth-1
+        discipline: the old pool handles park on ``_stale_handles`` and ride
+        out on the next window's ``Readback.consumed``, and completion is
+        acknowledged at that window's drain (``Readback.promotions``).  Never
+        syncs.  Returns False — degrading the chunk to a plain miss, with
+        NOTHING installed and the engine state untouched — on an injected
+        ``promote_h2d`` fault, a torn payload, or unrecoverable page
+        pressure."""
+        if faults.ACTIVE is not None and faults.ACTIVE.fire("promote_h2d"):
+            self.recorder.record(
+                "serve/fault", point="promote_h2d", rid=req.rid,
+                step=self._step_count,
+            )
+            return False
+        payload = self.prefix_cache.node_payload(node)
+        if payload is None:
+            return False
+        npg = bucket // self.page_size
+        ids = self.kv.allocator.alloc(npg)
+        if ids is None:
+            if not self._reclaim_pages(npg, allow_preempt=False):
+                return False
+            ids = self.kv.allocator.alloc(npg)
+            if ids is None:
+                return False
+        kv = self.kv
+        ck, cv, cks, cvs = payload
+        if isinstance(ck, np.ndarray):
+            # landed (or disk-reloaded) payload: H2D upload, pool placement
+            ck, cv = self._put_kv_chunk(ck), self._put_kv_chunk(cv)
+            cks = self._put_scale_chunk(cks)
+            cvs = self._put_scale_chunk(cvs)
+        # else: the spill gather hasn't drained yet — its device outputs feed
+        # the install directly, ordered behind the gather by dispatch order
+        behind = self._inflight is not None or self._prev_handle is not None
+        # admission may run under an in-flight window that consumes the pool
+        # handles: park them so the rebind below never drops a consumed handle
+        self._stale_handles += [kv.pages_k, kv.pages_v,
+                                kv.k_scales, kv.v_scales]
+        with self.tracer.span("serve/promote_h2d", bucket=bucket,
+                              behind_window=behind):
+            (kv.pages_k, kv.pages_v, kv.k_scales,
+             kv.v_scales) = self._promote_install[bucket](
+                kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+                ck, cv, cks, cvs, self._put(np.asarray(ids, np.int32)),
+            )
+        self.kv.lane_append_owned(req.slot, ids)  # lane takes the alloc ref
+        if self.prefix_cache.promote_node(node, ids):
+            # re-admitted to the device tier: the cache holds its own ref per
+            # page (dropped again by _on_prefix_evict); on failure the node
+            # stays spilled and only the lane owns the pages
+            self.kv.allocator.ref(ids)
+        self._pending_promotions.append({
+            "rid": req.rid, "bucket": bucket, "behind_window": behind,
+            "step": self._step_count,
+        })
+        self.recorder.record(
+            "serve/promote_h2d", rid=req.rid, bucket=bucket,
+            behind_window=behind, step=self._step_count,
+        )
+        return True
+
+    def _settle_spills(self, entries: list) -> None:
+        """Land pending spill payloads (drain side): the producing gathers
+        retired behind the window that just drained, so each fetch returns
+        without a real wait.  Entries whose node moved on (promoted, healed,
+        or dropped while the gather was in flight) are fetched and discarded
+        — fetching first keeps the handle-drop from ever blocking on a
+        consumer still in flight."""
+        for node, handles in entries:
+            arrays = fetch(*handles)
+            if self.prefix_cache is not None and node.host is handles:
+                self.prefix_cache.settle_payload(node, arrays)
 
     def _admission_pages_ok(self, req: Request) -> bool:
         """Can the queue head's whole prefill be paged in?  Conservative
@@ -1332,7 +1554,12 @@ class ServingEngine:
         preemption — evicting a running lane to admit behind it would invert
         FCFS and can livelock under steady overload."""
         padded = sum(b for b, _ in req.chunks)
-        cached = sum(b for b, _ in req.chunks[:req.cached_chunks])
+        # only device-tier cached chunks alias for free; spilled chunks
+        # promote into freshly allocated pages and must be charged
+        cached = sum(
+            b for i, (b, _) in enumerate(req.chunks[:req.cached_chunks])
+            if i < len(req.cache_nodes) and req.cache_nodes[i].tier == "device"
+        )
         need = (padded - cached) // self.page_size
         if self.kv.allocator.free_count >= need:
             return True
@@ -1346,7 +1573,11 @@ class ServingEngine:
         if req.next_chunk >= len(req.chunks):
             return True
         if req.next_chunk < req.cached_chunks:
-            return True  # cached chunk: aliases resident pages, allocates none
+            node = (req.cache_nodes[req.next_chunk]
+                    if req.next_chunk < len(req.cache_nodes) else None)
+            if node is None or node.tier == "device":
+                return True  # device-tier hit: aliases pages, allocates none
+            # spilled chunk: promotion scatter-installs into fresh pages
         bucket, _ = req.chunks[req.next_chunk]
         need = bucket // self.page_size
         if self.kv.allocator.free_count >= need:
@@ -1492,7 +1723,7 @@ class ServingEngine:
             ids = self.kv.chunk_ids(req.slot, start // self.page_size, npg)
             node = self.prefix_cache.insert_pages(
                 parent, ptoks[start:start + bucket], ids,
-                nbytes=npg * self.kv.page_kv_bytes,
+                nbytes=self.kv.chunk_bytes(npg),
             )
             if node is not None and node.pages == tuple(ids):
                 # a NEW node was created: the cache holds its own reference
@@ -1898,6 +2129,15 @@ class ServingEngine:
             wait_ms=wait * 1e3, overlapped_ms=host * 1e3,
         )
         hd.consumed.clear()
+        if hd.spills:
+            # the producing gathers retired behind the window that just
+            # drained: land the host payloads now, off the device
+            self._settle_spills(hd.spills)
+            hd.spills = []
+        for rec in hd.promotions:
+            # install retired with the window it was enqueued behind
+            self.recorder.record("serve/promote_land", **rec)
+        hd.promotions = []
         if hd.qerr is not None and self._kv_quant_gauge is not None:
             self._kv_quant_gauge.set(float(fetch(hd.qerr)))
         if hd.prefill_qerrs and self._kv_quant_gauge is not None:
@@ -2245,21 +2485,40 @@ class ServingEngine:
             self._cycle_decode_tokens = 0
             self._admit()
             self._prev_handle = self._dispatch_decode()
+        tgt = (self._inflight if self._inflight is not None
+               else self._prev_handle)
         if self._pending_prefill_qerr:
             # hand the chunk quant-error handles to a window that retires
             # no earlier than the chunks do — fetched at ITS drain
-            tgt = (self._inflight if self._inflight is not None
-                   else self._prev_handle)
             if tgt is not None:
                 tgt.prefill_qerrs.extend(self._pending_prefill_qerr)
                 self._pending_prefill_qerr.clear()
+        if self._pending_spills or self._pending_promotions:
+            # same discipline for hierarchical-cache traffic: spill payloads
+            # land, and promotions are acknowledged, at the drain of a window
+            # that provably retires after them
+            if tgt is not None:
+                tgt.spills.extend(self._pending_spills)
+                tgt.promotions.extend(self._pending_promotions)
+            else:
+                # no window in flight (idle engine / async_depth=0 gap):
+                # nothing to hide the fetch behind, settle on the spot
+                self._settle_spills(self._pending_spills)
+                for rec in self._pending_promotions:
+                    self.recorder.record("serve/promote_land", **rec)
+            self._pending_spills = []
+            self._pending_promotions = []
         prev, self._prev_handle = self._prev_handle, None
         if prev is not None:
             self._drain(prev)
         if self.prefix_cache is not None:
             covered = self.stats["prefix_hit_tokens"] + self.stats["prefix_miss_tokens"]
             if covered:
-                self._hit_rate_gauge.set(self.stats["prefix_hit_tokens"] / covered)
+                hit = self.stats["prefix_hit_tokens"]
+                host_hit = self.stats["prefix_hit_tokens_host"]
+                self._hit_rate_gauge.set(hit / covered)
+                self._hit_rate_device_gauge.set((hit - host_hit) / covered)
+                self._hit_rate_host_gauge.set(host_hit / covered)
         self._update_prefill_gauges()
         if self.paged:
             self.kv.publish_gauges()
@@ -2399,7 +2658,11 @@ class ServingEngine:
         copy-on-write); cache hits alias pages, so the hit path adds no
         executable at all.  ``lane_install`` is the one-slot lane-vector
         scatter admissions enqueue once the device mirror exists — 0 when
-        every install landed before the first window."""
+        every install landed before the first window.  The host spill tier
+        (``prefix_host_mb > 0``) adds exactly one ``spill_<bucket>`` D2H
+        gather and one ``promote_<bucket>`` H2D scatter-install per prefill
+        bucket — the documented, bounded growth of the compiled budget; each
+        stays 0 until the first spill/promotion of that bucket."""
         out = {"decode_window": jit_cache_sizes(self._decode),
                "lane_install": jit_cache_sizes(self._lane_install)}
         if self.paged:
@@ -2412,4 +2675,8 @@ class ServingEngine:
             out[f"prefill_{b}"] = jit_cache_sizes(f)
         for b, f in self._copy.items():
             out[f"copy_{b}"] = jit_cache_sizes(f)
+        for b, f in self._spill_extract.items():
+            out[f"spill_{b}"] = jit_cache_sizes(f)
+        for b, f in self._promote_install.items():
+            out[f"promote_{b}"] = jit_cache_sizes(f)
         return out

@@ -60,6 +60,7 @@ FAULT_POINTS = (
     "hot_swap_upload",    # raise mid weight upload, after the drain barrier
     "handler_disconnect", # break the SSE socket write (client vanished)
     "replica_kill",       # poison the busiest replica wholesale (router)
+    "promote_h2d",        # raise before a spilled-prefix H2D promotion (engine)
 )
 
 

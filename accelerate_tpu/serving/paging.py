@@ -304,6 +304,16 @@ class PagedKVPool:
         the kv-head axis, which splits exactly over the tp degree."""
         return self.kv_bytes() // self.tp_degree
 
+    def chunk_bytes(self, npages: int) -> int:
+        """Bytes ``npages`` pages of KV cost — K+V data at the storage dtype
+        PLUS both per-page f32 scale slabs.  The ONE accounting unit every
+        byte budget that charges per chunk must use (`prefix_cache_mb`,
+        `prefix_host_mb`, the shared-bytes gauge): quantized pools carry real
+        HBM in the scale slabs, and a budget that counted data bytes only
+        would under-charge int8/fp8 entries by ``L * Hkv * 8`` bytes per
+        page."""
+        return int(npages) * self.page_kv_bytes
+
     def publish_gauges(self) -> None:
         self._in_use_gauge.set(self.allocator.used_count)
         self._free_gauge.set(self.allocator.free_count)

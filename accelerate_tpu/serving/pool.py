@@ -778,6 +778,75 @@ def make_copy_page(shardings: Optional[ServeShardings] = None):
     )
 
 
+def make_spill_extract(npages: int, shardings: Optional[ServeShardings] = None):
+    """Jitted D2H-side gather for the hierarchical prefix cache's spill path:
+    ``(pages_k, pages_v, k_scales, v_scales, ids [npages]) -> (chunk_k
+    [L, npages, page, Hkv, Dh], chunk_v, chunk_k_scales [L, npages, Hkv],
+    chunk_v_scales)`` packs one evicted chunk's pages (quant scales ride
+    along, so int8/fp8 chunks spill at their quantized density) into dense
+    per-chunk arrays the engine fetches at its drain point — the gather is
+    enqueued, never synced, and NOTHING is donated: the pool stays live for
+    the in-flight decode window.  One compiled shape per prefill bucket
+    (``npages = bucket // page_size``), so the compiled budget grows by
+    exactly the bucket set.
+    """
+
+    def spill_extract(pages_k, pages_v, k_scales, v_scales, ids):
+        if ids.shape[0] != npages:
+            raise ValueError(
+                f"spill_extract compiled for {npages} pages, got {ids.shape[0]}"
+            )
+        return (jnp.take(pages_k, ids, axis=1),
+                jnp.take(pages_v, ids, axis=1),
+                jnp.take(k_scales, ids, axis=1),
+                jnp.take(v_scales, ids, axis=1))
+
+    s = shardings
+    return _serve_jit(
+        spill_extract,
+        in_shardings=None if s is None else (
+            s.kv, s.kv, s.scales, s.scales, s.replicated,
+        ),
+        out_shardings=None if s is None else (s.kv, s.kv, s.scales, s.scales),
+    )
+
+
+def make_promote_install(npages: int, shardings: Optional[ServeShardings] = None):
+    """Jitted H2D-side scatter for the hierarchical prefix cache's promotion
+    path: ``(pages_k, pages_v, k_scales, v_scales, chunk_k, chunk_v,
+    chunk_k_scales, chunk_v_scales, ids [npages]) -> (pages_k, pages_v,
+    k_scales, v_scales)`` installs a spilled chunk's payload into freshly
+    allocated pages.  The pool arrays are donated (in-place alias per shard,
+    the decode-window discipline), so the engine parks the old handles on the
+    in-flight window's ``Readback.consumed`` before rebinding — the install
+    enqueues *behind* the window and overlaps the decode it rides with.  One
+    compiled shape per prefill bucket, mirroring :func:`make_spill_extract`.
+    """
+
+    def promote_install(pages_k, pages_v, k_scales, v_scales,
+                        chunk_k, chunk_v, chunk_k_scales, chunk_v_scales, ids):
+        if ids.shape[0] != npages:
+            raise ValueError(
+                f"promote_install compiled for {npages} pages, got {ids.shape[0]}"
+            )
+        pages_k = pages_k.at[:, ids].set(chunk_k.astype(pages_k.dtype))
+        pages_v = pages_v.at[:, ids].set(chunk_v.astype(pages_v.dtype))
+        k_scales = k_scales.at[:, ids].set(chunk_k_scales.astype(k_scales.dtype))
+        v_scales = v_scales.at[:, ids].set(chunk_v_scales.astype(v_scales.dtype))
+        return pages_k, pages_v, k_scales, v_scales
+
+    s = shardings
+    return _serve_jit(
+        promote_install,
+        donate_argnums=(0, 1, 2, 3),
+        in_shardings=None if s is None else (
+            s.kv, s.kv, s.scales, s.scales,
+            s.kv, s.kv, s.scales, s.scales, s.replicated,
+        ),
+        out_shardings=None if s is None else (s.kv, s.kv, s.scales, s.scales),
+    )
+
+
 def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int], ...]:
     """Split a prompt into prefill chunks drawn from the fixed bucket sizes.
 

@@ -91,6 +91,17 @@ class Readback:
     #: parks the handles here and folds them into the quant-error gauge at
     #: drain — by which point the chunks have long retired behind the window.
     prefill_qerrs: list = dataclasses.field(default_factory=list)
+    #: pending prefix-cache spills riding this window: ``(node, handles)``
+    #: pairs whose D2H gathers were enqueued before this window dispatched.
+    #: Fetching a gather eagerly would sync the pipeline at eviction time, so
+    #: the engine parks the handles here and lands them into the node's host
+    #: payload at drain — behind the same blocking point everything else
+    #: syncs at.
+    spills: list = dataclasses.field(default_factory=list)
+    #: spilled-prefix promotions dispatched behind this window (host -> device
+    #: H2D install records): completion is acknowledged at drain, where the
+    #: install has provably retired with the window it was enqueued behind.
+    promotions: list = dataclasses.field(default_factory=list)
 
     def lane_live(self, slot: int) -> bool:
         """Was ``slot`` active when this window was dispatched?  A live lane's

@@ -1992,6 +1992,242 @@ def _chaos_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _hier_ab_bench(args, model, cfg, params, preset):
+    """Hierarchical prefix cache A/B: host-RAM spill tier on vs off.
+
+    The workload is grouped shared-prefix traffic whose distinct-prefix
+    working set is ~10x the device-tier budget (``prefix_cache_mb`` holds ~1
+    cached prefix, the rounds cycle through 10): without the host tier the
+    device LRU thrashes and every returning group re-prefills its prefix from
+    scratch; with it the evicted prefix spills to host RAM and each return is
+    an H2D promotion enqueued behind the in-flight decode window.  Every
+    check is HARD (SystemExit on failure):
+
+    * greedy outputs token-identical between the arms (promotions land
+      mid-decode under ``async_depth=1`` and must be invisible);
+    * the on-arm actually serves prefix tokens from the host tier
+      (``prefix_hit_tokens_host`` and ``serve/prefix_hit_rate_host`` > 0);
+    * tokens/s >= 1.25x the spill-off arm and mean TTFT improved — the spill
+      tier must BUY something on the oversubscribed mix, not just not lose;
+    * promotion is overlapped, not serial: ``serve/host_overlap_ratio``
+      stays > 0 and at least one ``serve/promote_h2d`` flight event carries
+      ``behind_window=True`` (no synchronous fetch at admission);
+    * zero new blocking readbacks on the hot path: in-process atpu-lint over
+      the repo surface stays clean;
+    * the compiled-executable budget grows by EXACTLY the documented set —
+      one ``spill_<bucket>`` D2H gather + one ``promote_<bucket>`` H2D
+      install per prefill bucket, each compiled at most once.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.serving.paging import PagedKVPool
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)
+    window = args.decode_window
+    mp_full = max(16, min(args.seq, cfg.max_seq_len) // 2)
+    page = max(4, mp_full // 4)
+    buckets = (page, 4 * page)
+    prefix_len = 4 * page              # exactly one full cacheable chunk
+    mp = prefix_len + page             # room for a partial (uncached) suffix
+    max_len = min(
+        (cfg.max_seq_len // page) * page,
+        ((mp + 4 * window) // page + 1) * page,
+    )
+    # few slots + a deep queue: decode windows stay in flight across every
+    # admission (promotions genuinely overlap) and TTFT is queue-dominated,
+    # so it tracks throughput instead of per-request scheduling jitter
+    slots = min(args.batch, 4)
+
+    groups = 10
+    rounds = max(6, args.requests // groups)
+    r = np.random.default_rng(args.serve_seed)
+    prefixes = [
+        r.integers(1, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+        for _ in range(groups)
+    ]
+    # round-robin across groups: by the time a group returns, the 9 prefixes
+    # in between have thrashed it out of the 1-node device tier
+    prompts = [
+        np.concatenate(
+            [prefixes[g],
+             r.integers(1, cfg.vocab_size, (int(r.integers(2, page)),))
+             .astype(np.int32)]
+        )
+        for _ in range(rounds) for g in range(groups)
+    ]
+    n = len(prompts)
+    gens = [GenerationConfig(max_new_tokens=window) for _ in range(n)]
+    useful_tokens = n * window
+
+    # size the device tier from the pool's own accounting (a prefix node
+    # costs 2 pages' data + scale slabs): ~1 resident node -> 10x working set
+    probe = PagedKVPool(cfg, 1, page, page, 2, registry=MetricsRegistry())
+    node_bytes = (prefix_len // page) * probe.page_kv_bytes
+    del probe
+    dev_mb = 1.05 * node_bytes / 2**20
+    host_mb = 4.0 * groups * node_bytes / 2**20
+    num_pages = slots * (max_len // page) + 4 * (prefix_len // page) + 1
+
+    def run_arm(arm_host_mb):
+        registry = MetricsRegistry()
+        eng = ServingEngine(
+            model, params, num_slots=slots, max_len=max_len,
+            max_prompt_len=mp, prefill_buckets=buckets, decode_window=window,
+            paged=True, page_size=page, num_pages=num_pages,
+            prefix_cache_mb=dev_mb, prefix_host_mb=arm_host_mb,
+            async_depth=1, registry=registry,
+        )
+        # warmup compiles every executable the timed region touches: both
+        # prefill buckets + insert + decode (A, B), the spill gather (B's
+        # insert evicts A), and the promote install (A's return hits its
+        # spilled node)
+        wa = r.integers(1, cfg.vocab_size, (prefix_len + 2,)).astype(np.int32)
+        wb = r.integers(1, cfg.vocab_size, (prefix_len + 2,)).astype(np.int32)
+        eng.serve([wa, wb, wa.copy()], GenerationConfig(max_new_tokens=window))
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.flush()
+        for k in eng.stats:
+            eng.stats[k] = 0
+        registry.reset()
+        eng.recorder.clear()
+        # best-of-N walls: the timed region is sub-second, so a single OS
+        # scheduling stall swamps the ratio — transient noise only ever
+        # inflates a wall, so min is the stable estimator.  Repeats start
+        # from the steady tier state the previous pass left (exactly the
+        # long-running-service shape this bench models) and double as a
+        # no-retrace check: the compiled-budget gate still requires <= 1
+        # compile per executable across every pass.
+        dt = float("inf")
+        for _ in range(max(3, args.iters)):
+            t0 = time.perf_counter()
+            reqs = eng.serve(prompts, gens)
+            dt = min(dt, time.perf_counter() - t0)
+        # snapshot now: the recorder is process-global and the other arm's
+        # clear() would wipe these events
+        events = list(eng.recorder.tail())
+        return eng, reqs, dt, registry, events
+
+    eng_on, reqs_on, dt_on, reg_on, events_on = run_arm(host_mb)
+    eng_off, reqs_off, dt_off, reg_off, _ = run_arm(0.0)
+
+    if [q.tokens for q in reqs_on] != [q.tokens for q in reqs_off]:
+        raise SystemExit(
+            "--hier-ab identity: host spill tier changed greedy outputs vs "
+            "the spill-off arm on the same workload"
+        )
+    host_hit_tokens = eng_on.stats["prefix_hit_tokens_host"]
+    host_hit_rate = float(reg_on.get("serve/prefix_hit_rate_host").value)
+    if host_hit_tokens <= 0 or host_hit_rate <= 0:
+        raise SystemExit(
+            f"--hier-ab: no prefix tokens were served from the host tier "
+            f"(hit tokens {host_hit_tokens}, rate {host_hit_rate}) on a "
+            "10x-oversubscribed mix — the spill tier never engaged"
+        )
+    tps_on = useful_tokens / dt_on
+    tps_off = useful_tokens / dt_off
+    speedup = tps_on / tps_off
+    if speedup < 1.25:
+        raise SystemExit(
+            f"--hier-ab: spill tier bought only {speedup:.3f}x tokens/s "
+            f"({tps_on:.2f} vs {tps_off:.2f}) — gate is >= 1.25x on the "
+            "oversubscribed shared-prefix mix"
+        )
+    ttft_on = reg_on.get("serve/ttft_s").snapshot()["mean"]
+    ttft_off = reg_off.get("serve/ttft_s").snapshot()["mean"]
+    if ttft_on >= ttft_off:
+        raise SystemExit(
+            f"--hier-ab: mean TTFT did not improve with the host tier "
+            f"({1e3 * ttft_on:.2f}ms vs {1e3 * ttft_off:.2f}ms spill-off)"
+        )
+    overlap = float(reg_on.get("serve/host_overlap_ratio").value)
+    if overlap <= 0:
+        raise SystemExit(
+            "--hier-ab: serve/host_overlap_ratio is 0 — the promotion path "
+            "serialized the async loop"
+        )
+    promote_events = [e for e in events_on
+                      if e.get("kind") == "serve/promote_h2d"]
+    if not any(e.get("behind_window") for e in promote_events):
+        raise SystemExit(
+            "--hier-ab: no promotion was enqueued behind an in-flight decode "
+            "window — promotions ran serially at admission"
+        )
+
+    import io
+    from tools.atpu_lint.cli import main as atpu_lint_main
+    buf = io.StringIO()
+    if atpu_lint_main([], stdout=buf, stderr=buf) != 0:
+        raise SystemExit(
+            "--hier-ab: atpu-lint found new hot-path violations (blocking "
+            f"readbacks / host syncs):\n{buf.getvalue()}"
+        )
+
+    counts_on = eng_on.compiled_executable_counts()
+    counts_off = eng_off.compiled_executable_counts()
+    expected_extra = ({f"spill_{b}" for b in buckets}
+                      | {f"promote_{b}" for b in buckets})
+    extra = set(counts_on) - set(counts_off)
+    if extra != expected_extra:
+        raise SystemExit(
+            f"--hier-ab: compiled-executable budget grew by {sorted(extra)}, "
+            f"expected exactly {sorted(expected_extra)}"
+        )
+    over = {k: v for k, v in counts_on.items() if v > 1}
+    if over or counts_on[f"spill_{prefix_len}"] != 1 \
+            or counts_on[f"promote_{prefix_len}"] != 1:
+        raise SystemExit(
+            f"--hier-ab: spill/install executables retraced or never "
+            f"compiled: over-budget {over}, "
+            f"spill_{prefix_len}={counts_on[f'spill_{prefix_len}']}, "
+            f"promote_{prefix_len}={counts_on[f'promote_{prefix_len}']}"
+        )
+
+    def arm_detail(eng, dt, reg):
+        ttft = reg.get("serve/ttft_s").snapshot()
+        return {
+            "wall_s": round(dt, 3),
+            "tokens_per_s": round(useful_tokens / dt, 2),
+            "ttft_mean_ms": round(1e3 * ttft["mean"], 2),
+            "ttft_p99_ms": round(1e3 * ttft["p99"], 2),
+            "prefix_hit_tokens": eng.stats["prefix_hit_tokens"],
+            "prefix_hit_tokens_host": eng.stats["prefix_hit_tokens_host"],
+            "prefix_cache": eng.prefix_cache_stats(),
+            "compiled_executables": eng.compiled_executable_counts(),
+        }
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "groups": groups,
+        "rounds": rounds,
+        "prefix_len": prefix_len,
+        "page_size": page,
+        "prefill_buckets": list(buckets),
+        "prefix_cache_mb": round(dev_mb, 5),
+        "prefix_host_mb": round(host_mb, 5),
+        "working_set_over_device_budget": round(
+            groups * node_bytes / (dev_mb * 2**20), 2),
+        "useful_tokens": useful_tokens,
+        "outputs_token_identical": True,
+        "host_hit_rate": round(host_hit_rate, 4),
+        "host_overlap_ratio": round(overlap, 4),
+        "promotions_behind_window": sum(
+            1 for e in promote_events if e.get("behind_window")),
+        "atpu_lint_clean": True,
+        "spill_on": arm_detail(eng_on, dt_on, reg_on),
+        "spill_off": arm_detail(eng_off, dt_off, reg_off),
+    }
+    return {
+        "metric": "serving_hier_cache_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -2017,13 +2253,16 @@ def _serve_bench(args, model, cfg, params, preset):
             bool(getattr(args, "http_ab", False)),
             bool(getattr(args, "chaos_ab", False)),
             bool(getattr(args, "prefill_ab", False)),
+            bool(getattr(args, "hier_ab", False)),
             bool(args.shared_prefix)]) > 1:
         raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab, "
-                         "--http-ab, --chaos-ab, --prefill-ab and "
-                         "--shared-prefix are separate serve workloads; "
+                         "--http-ab, --chaos-ab, --prefill-ab, --hier-ab "
+                         "and --shared-prefix are separate serve workloads; "
                          "pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "hier_ab", False):
+        return _hier_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "http_ab", False):
         return _http_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "chaos_ab", False):
@@ -2265,6 +2504,14 @@ def main():
                              "token-identity, executable-budget, and chat "
                              "p99-TTFT >= 1.3x hard checks; prefill tokens/s "
                              "gated on TPU")
+    parser.add_argument("--hier-ab", dest="hier_ab", action="store_true",
+                        help="--task serve: A/B the hierarchical prefix cache "
+                             "(host-RAM spill tier + decode-overlapped H2D "
+                             "promotion) against spill-off on a shared-prefix "
+                             "mix whose working set is ~10x prefix_cache_mb — "
+                             "token-identity, host hit rate > 0, tokens/s >= "
+                             "1.25x, mean-TTFT, overlap, atpu-lint, and "
+                             "executable-budget hard checks")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",
                         help="--kernel-ab: quantized KV page format for the "

@@ -1,0 +1,13 @@
+"""blocking-readback (host spill tier): eager syncs on the spill gather's
+handles at eviction time — two flagged lines (device_get call,
+block_until_ready call) — re-serializing the pipeline on every demotion."""
+import jax
+
+
+def spill_node(extract, kv, ids, pending):
+    ck, cv, cks, cvs = extract(
+        kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, ids)
+    host_k = jax.device_get(ck)
+    cvs.block_until_ready()
+    pending.append((host_k, cv, cks, cvs))
+    return pending

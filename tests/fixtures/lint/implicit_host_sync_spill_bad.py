@@ -1,0 +1,23 @@
+"""implicit-host-sync (host spill tier): the spill D2H gather's outputs
+converted host-side at eviction time — four violations (np.asarray x2,
+truth-test, int) — instead of parking the handles on the pending-spill list
+for the next drain."""
+import numpy as np
+
+
+class Engine:
+    def __init__(self, npages):
+        self._spill = _serve_jit(  # noqa: F821 — fixture stub
+            make_spill_extract(npages),  # noqa: F821 — fixture stub
+        )
+
+    def spill_node(self, node):
+        kv = self.kv
+        ids = self._put(np.asarray(node.pages, np.int32))
+        ck, cv, cks, cvs = self._spill(
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, ids)
+        host_k = np.asarray(ck)
+        host_v = np.asarray(cv)
+        if cks.any():
+            node.scale_hint = int(cvs[0, 0, 0])
+        return host_k, host_v

@@ -1,0 +1,34 @@
+"""jit-signature-drift (promote H2D install): the per-bucket install dict fed
+call-varying shapes — three violations (chunk sliced by the node's drifting
+page count, a pad constructor sized by it, the drifting count itself passed
+positionally as the ids argument).  The final call is the repo's actual idiom
+— bucket-padded payload, subscript dispatch on the padded size — and must
+stay unflagged."""
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self, bucket, page_size):
+        self._promote = {
+            bucket: _serve_jit(  # noqa: F821 — fixture stub
+                make_promote_install(bucket // page_size),  # noqa: F821
+            ),
+        }
+
+    def promote(self, node, chunk, kv, ids):
+        n = len(node.pages)
+        bad_slice = self._promote[64](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k[:n], chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        bad_pad = self._promote[64](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            jnp.zeros(n, jnp.int32), chunk.v, chunk.k_scales, chunk.v_scales,
+            ids)
+        bad_ids = self._promote[64](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k, chunk.v, chunk.k_scales, chunk.v_scales, n)
+        good = self._promote[64](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            pad_to_bucket(chunk.k, 64),  # noqa: F821 — fixture stub
+            chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        return bad_slice, bad_pad, bad_ids, good

@@ -1,0 +1,28 @@
+"""use-after-donate (promote H2D install): the scatter-install donates all
+four pool arrays — two violations: a read of the donated ``kv.pages_k`` after
+dispatch (``promote_then_audit``), and the donate-and-rebind in ``promote``
+dropping the old pool handles without parking them while the in-flight decode
+window may still consume them."""
+
+
+class Engine:
+    def __init__(self, npages):
+        self._promote = _serve_jit(  # noqa: F821 — fixture stub
+            make_promote_install(npages),  # noqa: F821 — fixture stub
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    def promote(self, chunk, ids):
+        kv = self.kv
+        kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales = self._promote(
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k, chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        return kv
+
+    def promote_then_audit(self, chunk, ids):
+        kv = self.kv
+        new_k, new_v, new_ks, new_vs = self._promote(
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k, chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        stale = kv.pages_k.sum()
+        return new_k, new_v, new_ks, new_vs, stale

@@ -145,6 +145,53 @@ def test_jit_signature_drift_prefill_executables():
     assert "passed positionally" in msgs
 
 
+def test_implicit_host_sync_spill_path():
+    """Materializing the spill D2H gather's outputs at eviction time fires
+    four ways; the sanctioned discipline (park handles, land at drain) has no
+    conversion to flag."""
+    report = run_rules(["implicit-host-sync"],
+                       ["implicit_host_sync_spill_bad.py"])
+    assert len(report.diagnostics) == 4, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "np.asarray() on a device value" in msgs
+    assert "truth-testing a device value" in msgs
+    assert "int() on a device value" in msgs
+
+
+def test_blocking_readback_spill_path():
+    """Eager syncs on the spill gather's handles — device_get plus
+    block_until_ready — are both flagged."""
+    report = run_rules(["blocking-readback"],
+                       ["blocking_readback_spill_bad.py"])
+    assert len(report.diagnostics) == 2, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "device_get" in msgs and "block_until_ready" in msgs
+
+
+def test_use_after_donate_promote_install():
+    """The promote H2D scatter-install donates all four pool arrays: reading
+    a donated handle afterwards and the unparked donate-and-rebind each
+    fire."""
+    report = run_rules(["use-after-donate"],
+                       ["use_after_donate_promote_bad.py"])
+    assert len(report.diagnostics) == 2, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "'kv.pages_k' was donated" in msgs and "read here" in msgs
+    assert "donate-and-rebind" in msgs and "park the old" in msgs
+
+
+def test_jit_signature_drift_promote_install():
+    """The per-bucket promote-install dict fed call-varying shapes fires
+    three ways; the bucket-padded payload dispatch idiom stays unflagged."""
+    report = run_rules(["jit-signature-drift"],
+                       ["jit_signature_drift_promote_bad.py"])
+    assert len(report.diagnostics) == 3, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "sliced by a call-varying bound" in msgs
+    assert "zeros(...) sized by a call-varying" in msgs
+    assert "passed positionally" in msgs
+
+
 def test_metric_docs_both_directions():
     root = FIX / "metric_docs_proj"
     report = run_rules(["metric-docs"], ["pkg"], root=root)
