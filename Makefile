@@ -57,6 +57,7 @@ bench-serve:
 	python bench_inference.py --task serve --async-ab
 	python bench_inference.py --task serve --http-ab
 	python bench_inference.py --task serve --chaos-ab
+	python bench_inference.py --task serve --trace-ab
 	python bench_inference.py --task spec
 
 # fault-tolerance gate: the deterministic fault-injection test suite plus the

@@ -35,7 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ...logging import get_logger
 from ...models.generation import GenerationConfig
-from ...telemetry import get_flight_recorder
+from ...telemetry import get_flight_recorder, get_reqtrace
 from ..errors import AdmissionError, DeadlineExceeded
 from ..router import ReplicaRouter
 from ..scheduler import Request, RequestState
@@ -139,7 +139,7 @@ class FrontDoor:
         self.idle_sleep_s = float(idle_sleep_s)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.ticket_timeout_s = float(ticket_timeout_s)
-        self.recorder = get_flight_recorder()
+        self.recorder = get_flight_recorder().tagged(engine="frontdoor")
         self._tickets: "queue.Queue[_Ticket]" = queue.Queue()
         # keyed by a front-door-minted id, NOT ``req.rid``: engine rids are
         # per-replica counters (and rewritten by failover adoption), so two
@@ -214,6 +214,10 @@ class FrontDoor:
             stream = TokenStream(self._next_key)
             stream_box.append(stream)
             self._outstanding[stream.rid] = (req, stream)
+            # the front-door key becomes the trace's authoritative id: it is
+            # what the API server echoes as X-Request-Id, and unlike the
+            # engine rid it never changes across failover adoption
+            get_reqtrace().rekey(req.trace, str(stream.rid))
             return req, stream
 
         return self._call(_do)

@@ -9,8 +9,9 @@ Routes (all JSON unless noted):
   version (the A/B surface; pin with ``"model": "<name>@<version>"``).
 - ``DELETE /v1/requests/<id>`` — cancel by response id (``cmpl-…`` /
   ``chatcmpl-…`` / bare rid), queued or running.
-- ``GET /metrics`` | ``/healthz`` | ``/debug/flight`` | ``/debug/stacks`` —
-  the telemetry surface, muxed onto this port through the shared
+- ``GET /metrics`` | ``/healthz`` | ``/debug/flight`` | ``/debug/stacks`` |
+  ``/debug/requests[/<id>]`` — the telemetry surface, muxed onto this port
+  through the shared
   :class:`~accelerate_tpu.telemetry.server.TelemetryEndpoints` (one process,
   one scrape target).  ``/healthz`` additionally aggregates per-replica
   router health: any stuck replica flips it to 503.
@@ -40,7 +41,12 @@ from typing import Any, Dict, Optional, Tuple
 from urllib.parse import urlsplit
 
 from ...logging import get_logger
-from ...telemetry import MetricsRegistry, TelemetryEndpoints, get_registry
+from ...telemetry import (
+    MetricsRegistry,
+    TelemetryEndpoints,
+    get_registry,
+    get_reqtrace,
+)
 from .. import faults
 from ..errors import AdmissionError, DeadlineExceeded
 from .frontdoor import FrontDoor
@@ -143,7 +149,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     "accelerate_tpu serving front door\n"
                     "endpoints: /v1/completions /v1/chat/completions "
                     "/v1/models /metrics /healthz /debug/flight "
-                    "/debug/stacks\n",
+                    "/debug/stacks /debug/requests\n",
                 )
             else:
                 code, ctype, body = api.endpoints.handle(parts.path, parts.query)
@@ -264,7 +270,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
             cancelled=stream.final_state is not None
             and stream.final_state.name == "CANCELLED",
             decode=api.decode,
-        ))
+        ), extra_headers={"X-Request-Id": request_id})
 
     def _stream_response(self, call: CompletionCall, rid: int, stream,
                          request_id: str, created: int, model: str) -> None:
@@ -280,6 +286,11 @@ class _ApiHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.close_connection = True
         first = True
+        # per-request waterfall: accumulate this handler thread's SSE write
+        # time into the trace (an overlay — it runs concurrently with engine
+        # phases on another thread, so it never enters the TTFT tiling)
+        trace = get_reqtrace().lookup(str(rid))
+        sse_t0 = time.perf_counter()
         try:
             while True:
                 try:
@@ -294,11 +305,14 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     # stand-in for the client's socket dying mid-stream: the
                     # except below must cancel the lane and free its pages
                     raise BrokenPipeError("injected SSE client disconnect")
+                w0 = time.perf_counter()
                 self.wfile.write(sse_frame(completion_chunk(
                     call, request_id, created, model, token, first,
                     decode=api.decode,
                 )).encode("utf-8"))
                 self.wfile.flush()
+                if trace is not None:
+                    trace.add_sse_write(time.perf_counter() - w0)
                 first = False
             cancelled = (stream.final_state is not None
                          and stream.final_state.name == "CANCELLED")
@@ -322,6 +336,9 @@ class _ApiHandler(BaseHTTPRequestHandler):
             # the client went away mid-stream: free its lane and KV now
             api.frontdoor.cancel(rid)
         finally:
+            if trace is not None and trace.sse_writes:
+                trace.overlay("sse_write", sse_t0, trace.sse_write_s,
+                              writes=trace.sse_writes)
             api.sse_streams.dec()
 
     def _safe_error(self, exc: Exception) -> None:

@@ -37,6 +37,7 @@ skipped; the decode path never changes.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import math
 import time
 from typing import Any, Callable, List, Optional, Sequence
@@ -55,6 +56,7 @@ from ..telemetry import (
     detect_device_peaks,
     get_flight_recorder,
     get_registry,
+    get_reqtrace,
     get_tracer,
     start_debug_server,
 )
@@ -89,6 +91,27 @@ logger = get_logger(__name__)
 # Serving latencies live between ~100 us (a CPU-test decode step) and ~100 s
 # (a deep queue on a loaded pool): 24 x2 buckets from 100 us cover it.
 _LATENCY_BUCKETS = tuple(1e-4 * 2.0**i for i in range(24))
+
+# Process-wide replica ids ("e0", "e1", ...): every flight-recorder event and
+# request-trace phase an engine emits is tagged with its id so multi-replica
+# rings stay disambiguable (the process-global recorder bit PR 14's bench).
+_ENGINE_IDS = itertools.count()
+
+
+class _Stats(dict):
+    """``ServingEngine.stats``: a plain numeric dict (benches reset it in
+    place, ``ReplicaRouter.stats`` sums its items) that is *also* callable —
+    ``engine.stats()`` returns a copy augmented with the per-request trace
+    rollup under ``"requests"``."""
+
+    def __call__(self) -> dict:
+        out = dict(self)
+        engine = getattr(self, "engine", None)
+        out["requests"] = (
+            get_reqtrace().summary(engine_id=engine.engine_id)
+            if engine is not None else {}
+        )
+        return out
 
 
 class ServingEngine:
@@ -484,7 +507,12 @@ class ServingEngine:
         # lifecycle events land in the process flight recorder, per-executable
         # FLOP/HBM signatures in a private cost table (filled lazily by
         # analyze_costs / a /metrics scrape — never in the serve loop).
-        self.recorder = get_flight_recorder()
+        # Every event this engine (and its scheduler) records carries the
+        # replica id; the per-request trace registry keys its waterfalls on
+        # the same id across failover.
+        self.engine_id = f"e{next(_ENGINE_IDS)}"
+        self.recorder = get_flight_recorder().tagged(engine=self.engine_id)
+        self.reqtrace = get_reqtrace()
         self.cost_table = CostTable(self.metrics)
         self.device_peaks = detect_device_peaks()
         self.debug_server = start_debug_server(
@@ -686,7 +714,8 @@ class ServingEngine:
         self._step_count = 0
         # ``stats`` stays a plain mutable dict — benches reset it in place —
         # while ``_bump`` mirrors every increment into cumulative counters.
-        self.stats = {
+        # (_Stats additionally answers ``stats()`` with a trace summary.)
+        self.stats = _Stats({
             "requests_submitted": 0,
             "requests_completed": 0,
             "tokens_generated": 0,
@@ -708,7 +737,8 @@ class ServingEngine:
             "hot_swaps": 0,
             "deadline_shed": 0,
             "requests_replayed": 0,
-        }
+        })
+        self.stats.engine = self
         self._counters = {
             k: self.metrics.counter(f"serve/{k}_total") for k in self.stats
         }
@@ -722,6 +752,26 @@ class ServingEngine:
         self._token_hist = self.metrics.histogram(
             "serve/token_latency_s", buckets=_LATENCY_BUCKETS,
             help="inter-token wall time (first token = TTFT)",
+        )
+        # Derived per-phase histograms, observed as request-trace phases close
+        # (telemetry/reqtrace.py): together they decompose serve/ttft_s.
+        self._queue_wait_hist = self.metrics.histogram(
+            "serve/queue_wait_s", buckets=_LATENCY_BUCKETS,
+            help="submit to first prefill chunk taken (trace queue_wait phase)",
+        )
+        self._prefill_phase_hist = self.metrics.histogram(
+            "serve/prefill_compute_s", buckets=_LATENCY_BUCKETS,
+            help="per-chunk prefill share of a request's waterfall "
+                 "(fresh compute, cached replay, or promoted chunks alike)",
+        )
+        self._decode_tok_hist = self.metrics.histogram(
+            "serve/decode_s_per_token", buckets=_LATENCY_BUCKETS,
+            help="per-request decode-window share amortized over the tokens "
+                 "the window committed (closes at drain, async-depth-aware)",
+        )
+        self._promote_wait_hist = self.metrics.histogram(
+            "serve/promote_wait_s", buckets=_LATENCY_BUCKETS,
+            help="host-tier promotion dispatch to landed-at-drain wait",
         )
         self._queue_gauge = self.metrics.gauge(
             "serve/queue_depth", help="requests queued or mid-prefill"
@@ -971,6 +1021,12 @@ class ServingEngine:
                       deadline_s=None if deadline_s is None else float(deadline_s),
                       request_class=request_class)
         self._next_rid += 1
+        # the waterfall opens here: queue_wait runs until the first prefill
+        # chunk is taken (None when tracing is off — every hook guards on it)
+        req.trace = self.reqtrace.begin(
+            rid=req.rid, engine=self.engine_id,
+            prompt_len=int(prompt.size), submit_t=now,
+        )
         self.scheduler.submit(req)
         self._bump("requests_submitted")
         if deadline_s is not None:
@@ -991,6 +1047,7 @@ class ServingEngine:
         req = self.scheduler.cancel(rid)
         if req is not None:
             self._bump("cancelled")
+            self.reqtrace.complete(req.trace, status="cancelled")
             return True
         for s in range(self.num_slots):
             req = self._slot_req[s]
@@ -1007,6 +1064,7 @@ class ServingEngine:
                 "serve/cancel_running", rid=rid, slot=s, step=self._step_count,
                 tokens=len(req.tokens),
             )
+            self.reqtrace.complete(req.trace, status="cancelled")
             return True
         return False
 
@@ -1153,6 +1211,10 @@ class ServingEngine:
             req.slot = None
             req.state = RequestState.QUEUED
         out.sort(key=lambda r: r.rid)
+        for req in out:
+            if req.trace is not None:
+                req.trace.annotate("export_inflight", rid=req.rid,
+                                   generated=len(req.tokens))
         self.recorder.record(
             "serve/export_inflight", count=len(out), step=self._step_count,
         )
@@ -1193,6 +1255,16 @@ class ServingEngine:
         old_rid = request.rid
         request.rid = self._next_rid
         self._next_rid += 1
+        if request.trace is not None:
+            # the SAME trace crosses replicas: close the ejection-to-adoption
+            # interval as a failover phase and re-index under the new rid —
+            # the waterfall continues rather than restarting
+            request.trace.phase(
+                "failover", from_engine=request.trace.engine,
+                to_engine=self.engine_id, old_rid=old_rid, rid=request.rid,
+                generated=len(request.tokens),
+            )
+            self.reqtrace.rebind(request.trace, self.engine_id, request.rid)
         self.scheduler.requeue(request)
         self._bump("requests_submitted")
         self._bump("requests_replayed")
@@ -1285,6 +1357,10 @@ class ServingEngine:
                 deadline_s=req.deadline_s, elapsed_s=elapsed,
                 tokens=len(req.tokens),
             )
+            if req.trace is not None:
+                req.trace.annotate("deadline_shed", where="running",
+                                   deadline_s=req.deadline_s)
+                self.reqtrace.complete(req.trace, status="shed")
         for req in list(self.scheduler.queue):
             if req.deadline_s is None:
                 continue
@@ -1299,6 +1375,10 @@ class ServingEngine:
                 "serve/deadline_shed", where="queued", rid=req.rid,
                 deadline_s=req.deadline_s, elapsed_s=elapsed,
             )
+            if req.trace is not None:
+                req.trace.annotate("deadline_shed", where="queued",
+                                   deadline_s=req.deadline_s)
+                self.reqtrace.complete(req.trace, status="shed")
         if any(r.deadline_s is not None for r in self.scheduler.prefills):
             any_live = True  # finishes its chunks; the running sweep catches it
         self._has_deadlines = any_live
@@ -1357,6 +1437,13 @@ class ServingEngine:
             if took is None:
                 return  # budget spent or page pressure: retry next step
             req, bucket, valid, start, cached = took
+            tr = req.trace
+            if tr is not None and not tr.queue_done:
+                # first chunk taken: the queue_wait phase ends here
+                tr.queue_done = True
+                self._queue_wait_hist.observe(
+                    tr.phase("queue_wait", queue_depth=self.scheduler.queue_depth)
+                )
             ptoks = req.prefill_tokens
             if cached:
                 node = req.cache_nodes[req.next_chunk - 1]
@@ -1412,6 +1499,15 @@ class ServingEngine:
                     self._bump("prefix_miss_tokens", valid)
                     self._populate_cache(req, bucket, valid, start, ptoks)
             self._bump("prefill_tokens", valid)
+            if tr is not None:
+                # one tiled phase per admitted chunk with hit-tier attribution
+                # (a degraded promotion re-entered the fresh path above)
+                source = ("fresh" if not cached
+                          else "promoted" if spilled else "cached")
+                self._prefill_phase_hist.observe(tr.phase(
+                    "prefill", chunk=req.next_chunk - 1, bucket=bucket,
+                    tokens=valid, source=source,
+                ))
             done = self.scheduler.finish_prefill()
             if done is not None:
                 self._install(done)
@@ -1527,8 +1623,11 @@ class ServingEngine:
             self.kv.allocator.ref(ids)
         self._pending_promotions.append({
             "rid": req.rid, "bucket": bucket, "behind_window": behind,
-            "step": self._step_count,
+            "step": self._step_count, "trace": req.trace,
         })
+        if req.trace is not None:
+            req.trace.annotate("promote_dispatch", bucket=bucket,
+                               behind_window=behind)
         self.recorder.record(
             "serve/promote_h2d", rid=req.rid, bucket=bucket,
             behind_window=behind, step=self._step_count,
@@ -1676,6 +1775,9 @@ class ServingEngine:
                 "serve/preempt", rid=req.rid, slot=int(s), step=self._step_count,
                 pages_freed=freed, effective_len=eff,
             )
+            if req.trace is not None:
+                req.trace.annotate("preempt", slot=int(s), pages_freed=freed,
+                                   generated=len(req.tokens))
             return True
         return False
 
@@ -1932,6 +2034,9 @@ class ServingEngine:
             "serve/finish", rid=req.rid, slot=slot, step=self._step_count,
             tokens=len(req.tokens), steps=self._step_count - req.submit_step,
         )
+        if req.trace is not None:
+            req.trace.tokens = len(req.tokens)
+            self.reqtrace.complete(req.trace, status="done")
 
     def _prefree_exhausted(self) -> None:
         """Retire lanes whose in-flight window provably exhausts their token
@@ -2136,6 +2241,11 @@ class ServingEngine:
             hd.spills = []
         for rec in hd.promotions:
             # install retired with the window it was enqueued behind
+            tr = rec.pop("trace", None)
+            if tr is not None and not tr.finished:
+                self._promote_wait_hist.observe(
+                    tr.phase("promote_wait", bucket=rec["bucket"])
+                )
             self.recorder.record("serve/promote_land", **rec)
         hd.promotions = []
         if hd.qerr is not None and self._kv_quant_gauge is not None:
@@ -2168,6 +2278,7 @@ class ServingEngine:
                 drafted_lanes=hd.n_drafted, committed=int(counts.sum()),
                 accepted=accepted,
             )
+        self._trace_drain(hd, counts, t0, t1)
         self._emit(toks, counts, mask=hd.active, reqs=hd.reqs, eos=hd.eos,
                    prefreed=hd.prefreed)
         if self.paged and hd.deferred_pages:
@@ -2176,6 +2287,28 @@ class ServingEngine:
             hd.settle(self.kv.allocator)
         if self._inflight is None:
             self._t_pipeline_empty = time.perf_counter()
+
+    def _trace_drain(self, hd: Readback, counts: np.ndarray,
+                     t0: float, t1: float) -> None:
+        """Close per-request decode/spec_verify waterfall phases at DRAIN —
+        under ``async_depth=1`` a window's cost is only known when its
+        readback lands, so this is where attribution is honest.  Each live
+        lane's phase spans its trace cursor to ``t1`` (the blocking fetch
+        tail included, so tiled phases keep summing to wall time); the tail
+        rides along as the phase's ``wait_s`` attribute, from which the
+        debug endpoints synthesize the ``readback_wait`` overlay — one dict
+        per lane per window here, not two.  Runs before ``_emit`` so the
+        phases land ahead of the first-token mark."""
+        phase = "spec_verify" if hd.kind == "verify" else "decode"
+        wait = max(t1 - t0, 0.0)
+        for s, req in hd.live_requests():
+            tr = req.trace
+            if tr is None or tr.finished:
+                continue
+            dur = tr.phase(phase, now=t1, step=self._step_count,
+                           lanes=hd.n_occupied, wait_s=wait)
+            n = max(int(counts[s]), 1)
+            self._decode_tok_hist.observe(dur / n, n)
 
     def _decode_cycle(self, n_occupied: int) -> Readback:
         """Dispatch one decode window and return its in-flight handle.  The
@@ -2402,6 +2535,8 @@ class ServingEngine:
                 continue
             if not req.tokens:
                 self._ttft_hist.observe(now - req.submit_time)
+                if req.trace is not None:
+                    req.trace.mark_first_token(now)
                 if req.request_class:
                     hist = self._class_ttft_hists.get(req.request_class)
                     if hist is None:
@@ -2505,6 +2640,11 @@ class ServingEngine:
                 # nothing to hide the fetch behind, settle on the spot
                 self._settle_spills(self._pending_spills)
                 for rec in self._pending_promotions:
+                    tr = rec.pop("trace", None)
+                    if tr is not None and not tr.finished:
+                        self._promote_wait_hist.observe(
+                            tr.phase("promote_wait", bucket=rec["bucket"])
+                        )
                     self.recorder.record("serve/promote_land", **rec)
             self._pending_spills = []
             self._pending_promotions = []

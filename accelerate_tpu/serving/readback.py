@@ -108,6 +108,19 @@ class Readback:
         pages must not return to the allocator until the window retires."""
         return self.active is not None and bool(self.active[slot])
 
+    def live_requests(self):
+        """``(slot, request)`` pairs for lanes live at dispatch — the lanes
+        this window owes tokens to (pre-freed lanes included: they were
+        active when the window dispatched).  Drain-side per-request
+        attribution (``engine._trace_drain``) iterates these against the
+        dispatch-time snapshot, not the possibly-moved-on live state."""
+        if self.active is None or self.reqs is None:
+            return
+        for s in np.nonzero(self.active)[0]:
+            req = self.reqs[s]
+            if req is not None:
+                yield int(s), req
+
     def settle(self, allocator) -> int:
         """Deref every deferred page (call only after :func:`fetch` on this
         window's outputs — i.e. after its KV writes provably landed)."""

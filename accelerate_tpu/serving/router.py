@@ -54,7 +54,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..telemetry import MetricsRegistry, get_flight_recorder, get_registry
+from ..telemetry import (
+    MetricsRegistry,
+    get_flight_recorder,
+    get_registry,
+    get_reqtrace,
+)
 from . import faults
 from .engine import ServingEngine
 from .errors import AdmissionError
@@ -100,7 +105,7 @@ class ReplicaRouter:
         self._draining: set = set()  # stable ids not admitting new requests
         self.policy = policy
         self.metrics = registry if registry is not None else get_registry()
-        self.recorder = get_flight_recorder()
+        self.recorder = get_flight_recorder().tagged(engine="router")
         self._rr_next = 0
         self._routed = 0
         self._affinity_hits = 0
@@ -414,6 +419,12 @@ class ReplicaRouter:
             return
         req.state = RequestState.CANCELLED
         req.deadline_exceeded = False
+        if req.trace is not None:
+            req.trace.annotate(
+                "replay_failed",
+                error=repr(last_err) if last_err is not None else "no survivors",
+            )
+            get_reqtrace().complete(req.trace, status="error")
         self.recorder.record(
             "serve/replay_failed", rid=req.rid,
             error=repr(last_err) if last_err is not None else "no survivors",

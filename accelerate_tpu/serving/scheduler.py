@@ -95,6 +95,11 @@ class Request:
     # traffic-class label ("chat", "batch", ...) for per-class TTFT
     # histograms; None stays out of the per-class series entirely
     request_class: Optional[str] = None
+    # per-request latency waterfall (telemetry.reqtrace.RequestTrace; None
+    # when tracing is off).  The SAME object rides through preemption,
+    # export_inflight, and failover adoption, so the waterfall spans replicas
+    # instead of restarting — ``adopt`` appends a ``failover`` phase to it.
+    trace: Optional[Any] = dataclasses.field(default=None, repr=False)
 
     @property
     def done(self) -> bool:
@@ -255,6 +260,11 @@ class Scheduler:
         request.cache_chain_broken = False
         self._match_prefix(request)
         self.queue.appendleft(request)
+        if request.trace is not None:
+            request.trace.annotate(
+                "requeue", effective_len=len(request.prefill_tokens),
+                cached_chunks=request.cached_chunks,
+            )
         self.recorder.record(
             "serve/requeue", rid=request.rid,
             effective_len=len(request.prefill_tokens),

@@ -18,8 +18,13 @@ Zero-dependency observability for the train and serve hot paths (see
 * :mod:`.flight_recorder` — bounded ring of lifecycle events, a stall
   detector that dumps all-thread stacks when progress heartbeats stop, and
   crash hooks writing JSON artifacts to ``ATPU_FLIGHT_DIR``.
+* :mod:`.reqtrace` — per-request latency waterfalls (queue wait, per-chunk
+  prefill, drain-attributed decode share, promote/readback waits) that
+  survive preemption and cross-replica failover; bounded ring + slowest-K
+  retention, served at ``/debug/requests[/<id>]``.
 * :mod:`.server` — opt-in stdlib HTTP daemon (``ATPU_METRICS_PORT``)
-  serving ``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``.
+  serving ``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``,
+  ``/debug/requests``.
 
 Everything is on by default and costs nanoseconds per observation;
 ``ATPU_TELEMETRY=0`` (or :func:`set_enabled` / ``get_tracer().enabled``)
@@ -51,6 +56,12 @@ from .metrics import (
     exponential_buckets,
     get_registry,
     set_enabled,
+)
+from .reqtrace import (
+    RequestTrace,
+    RequestTraceRegistry,
+    get_reqtrace,
+    tracing_enabled,
 )
 from .server import (
     DebugServer,
@@ -99,6 +110,10 @@ __all__ = [
     "get_flight_recorder",
     "install_crash_hooks",
     "all_thread_stacks",
+    "RequestTrace",
+    "RequestTraceRegistry",
+    "get_reqtrace",
+    "tracing_enabled",
     "DebugServer",
     "TelemetryEndpoints",
     "start_debug_server",

@@ -130,6 +130,13 @@ class FlightRecorder:
         self.record(kind, **fields)
         self._last_beat = self.clock()
 
+    def tagged(self, **tags: Any) -> "_TaggedRecorder":
+        """A view that stamps ``tags`` (e.g. ``engine="e0"``) onto every
+        ``record``/``heartbeat``.  Multi-replica runs (router, ``--tp-ab``,
+        chaos bench) share the process-global ring; without per-source tags
+        their events interleave indistinguishably."""
+        return _TaggedRecorder(self, tags)
+
     # -- introspection ----------------------------------------------------
 
     def heartbeat_age(self) -> Optional[float]:
@@ -216,6 +223,34 @@ class FlightRecorder:
         except Exception:
             logger.warning("flight recorder artifact write failed", exc_info=True)
             return None
+
+
+class _TaggedRecorder:
+    """Thin view over a :class:`FlightRecorder` that stamps fixed fields onto
+    every event.  Explicit per-call fields win over the tag on collision, and
+    everything else (``tail``, ``dump``, ``heartbeat_age`` …) forwards to the
+    underlying recorder, so the view drops in anywhere a recorder is passed."""
+
+    __slots__ = ("_recorder", "_tags")
+
+    def __init__(self, recorder: FlightRecorder, tags: Dict[str, Any]):
+        self._recorder = recorder
+        self._tags = dict(tags)
+
+    def record(self, kind: str, **fields: Any) -> None:
+        self._recorder.record(kind, **{**self._tags, **fields})
+
+    def heartbeat(self, kind: str, **fields: Any) -> None:
+        self._recorder.heartbeat(kind, **{**self._tags, **fields})
+
+    def tagged(self, **tags: Any) -> "_TaggedRecorder":
+        return _TaggedRecorder(self._recorder, {**self._tags, **tags})
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._recorder, name)
+
+    def __len__(self) -> int:
+        return len(self._recorder)
 
 
 class StallDetector:

@@ -18,6 +18,11 @@ surface is constructed with ``Accelerator(metrics_port=...)`` /
 - ``GET /debug/flight`` — ring-tail JSON from the flight recorder
   (``?n=100`` limits the tail).
 - ``GET /debug/stacks`` — plain-text stack traces of every live thread.
+- ``GET /debug/requests`` — per-request trace index (active + recent +
+  retained-slowest; see :mod:`accelerate_tpu.telemetry.reqtrace`).
+- ``GET /debug/requests/<id>`` — one request's phase waterfall, addressable
+  by the ``X-Request-Id`` the API server emits (``cmpl-N`` / bare rid);
+  ``?format=chrome`` returns a single-request Chrome-trace JSON instead.
 
 The server is a ``ThreadingHTTPServer`` on a daemon thread: it dies with the
 process and never blocks shutdown. ``ATPU_TELEMETRY=0`` disables it
@@ -36,6 +41,7 @@ from urllib.parse import parse_qs, urlsplit
 from ..logging import get_logger
 from .flight_recorder import FlightRecorder, all_thread_stacks, get_flight_recorder
 from .metrics import MetricsRegistry, enabled, get_registry
+from .reqtrace import get_reqtrace
 
 logger = get_logger(__name__)
 
@@ -174,6 +180,17 @@ class TelemetryEndpoints:
             return 200, "application/json", json.dumps(self.flight_tail(n), indent=1)
         if path == "/debug/stacks":
             return 200, "text/plain; charset=utf-8", self.render_stacks()
+        if path == "/debug/requests" or path == "/debug/requests/":
+            return 200, "application/json", json.dumps(get_reqtrace().index(), indent=1)
+        if path.startswith("/debug/requests/"):
+            key = path[len("/debug/requests/"):]
+            trace = get_reqtrace().lookup(key)
+            if trace is None:
+                return (404, "application/json",
+                        json.dumps({"error": "unknown request id", "id": key}))
+            fmt = parse_qs(query).get("format", [""])[0]
+            body = trace.chrome_trace() if fmt == "chrome" else trace.waterfall()
+            return 200, "application/json", json.dumps(body, indent=1, default=repr)
         return 404, "text/plain; charset=utf-8", "not found\n"
 
 
@@ -192,7 +209,8 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     "text/plain; charset=utf-8",
                     "accelerate_tpu debug server\n"
-                    "endpoints: /metrics /healthz /debug/flight /debug/stacks\n",
+                    "endpoints: /metrics /healthz /debug/flight /debug/stacks "
+                    "/debug/requests /debug/requests/<id>\n",
                 )
             else:
                 code, ctype, body = debug.endpoints.handle(parts.path, parts.query)

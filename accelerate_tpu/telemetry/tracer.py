@@ -15,13 +15,14 @@ TensorBoard timeline, lined up against the device stream.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import functools
 import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
 _DEVICE_TRACE_ACTIVE = False
 
@@ -50,7 +51,11 @@ class Tracer:
         self.enabled = enabled
         self.max_events = int(max_events)
         self.dropped_events = 0
-        self._events: List[Dict[str, Any]] = []
+        # deque(maxlen=) evicts the oldest event in O(1); the old list-FIFO
+        # paid an O(n) ``pop(0)`` under the lock on every span once the ring
+        # filled.  Eviction is silent, so the drop counter checks fullness
+        # before each append.
+        self._events: Deque[Dict[str, Any]] = collections.deque(maxlen=self.max_events)
         self._agg: Dict[str, Dict[str, float]] = {}
         self._local = threading.local()
         self._lock = threading.Lock()
@@ -98,7 +103,6 @@ class Tracer:
                 event["args"] = {**args, "depth": depth}
             with self._lock:
                 if len(self._events) >= self.max_events:
-                    self._events.pop(0)
                     self.dropped_events += 1
                 self._events.append(event)
                 agg = self._agg.get(name)

@@ -1992,6 +1992,379 @@ def _chaos_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _trace_ab_bench(args, model, cfg, params, preset):
+    """Request-trace A/B: waterfall fidelity on vs zero cost off.
+
+    Three arms over one greedy workload, each a HARD check (SystemExit):
+
+    * waterfall — two paged replicas behind the front door; the busy one is
+      killed mid-decode.  Every request must return HTTP 200 token-identical
+      to the in-process reference, and every response's ``X-Request-Id``
+      must resolve at ``GET /debug/requests/<id>`` to a waterfall whose
+      tiled phase sum attributes the trace's own TTFT within 5% (20ms
+      noise floor on shared CPU hosts).  At least one surviving request
+      must carry a ``failover`` phase spanning BOTH replica ids — the
+      trace rode ``export_inflight``/``adopt`` instead of restarting —
+      and the ``/debug/requests`` index must hold populated slowest-K
+      rings (the tail the tracing exists to explain);
+    * off — tracing toggled off (``reqtrace.set_enabled(False)``) must
+      serve token-identical to tracing on, and the null-calibrated paired
+      overhead (pooled median of rotating on/off/control min-of-2 samples)
+      must be <= 1% beyond the off-vs-off control drift measured in the
+      same run — per-request attribution may not tax serve throughput;
+    * budget — compile counts of every watchdog on both replicas must be
+      IDENTICAL before and after: tracing is host-side bookkeeping and
+      compiles NOTHING.
+
+    ``value`` is over-the-wire tokens/s during the kill arm (the traced,
+    failover-surviving path); ``vs_baseline`` divides by in-process
+    ``eng.serve`` tokens/s on the same workload.
+    """
+    import http.client
+    import threading
+
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import ReplicaRouter, ServingEngine
+    from accelerate_tpu.serving.api import ApiServer, FrontDoor
+    from accelerate_tpu.telemetry import MetricsRegistry, get_reqtrace
+    from accelerate_tpu.telemetry import reqtrace as reqtrace_mod
+
+    params = jax.device_put(params)
+    slots = args.batch
+    window = args.decode_window
+    page = 4
+    mp = -(-max(8, min(args.seq, cfg.max_seq_len) // 4) // page) * page
+    buckets = tuple(sorted({max(8, -(-(mp // 2) // page) * page), mp}))
+    new_tokens = 4 * window
+    n = args.requests
+    max_len = min(cfg.max_seq_len, -(-(mp + new_tokens + window) // page) * page)
+    num_pages = 2 * slots * (max_len // page) + 1
+    mq = max(8, slots, 2 * n)
+
+    r = np.random.default_rng(args.serve_seed)
+    prompt_lens = np.clip(
+        np.rint(r.lognormal(np.log(max(8, mp // 3)), 0.8, n)), 4, mp
+    ).astype(int)
+    prompts = [r.integers(1, cfg.vocab_size, (int(k),)).astype(np.int32)
+               for k in prompt_lens]
+    gen = GenerationConfig(max_new_tokens=new_tokens)
+    useful_tokens = n * new_tokens
+
+    registry = MetricsRegistry()
+    reqtrace_mod.set_enabled(None)
+    get_reqtrace().reset()
+
+    def build():
+        return ServingEngine(
+            model, params, num_slots=slots, max_len=max_len,
+            prefill_buckets=buckets, decode_window=window,
+            registry=registry, max_queue=mq, paged=True, page_size=page,
+            num_pages=num_pages, prefix_cache_mb=0,
+        )
+
+    e1, e2 = build(), build()
+    warm = [r.integers(1, cfg.vocab_size, (b,)).astype(np.int32)
+            for b in buckets]
+    for e in (e1, e2):
+        e.serve(warm, GenerationConfig(max_new_tokens=window))
+
+    t0 = time.perf_counter()
+    reqs = e1.serve(prompts, [gen] * n)
+    dt_inproc = time.perf_counter() - t0
+    ref = [[int(t) for t in q.tokens] for q in reqs]
+
+    def compile_counts():
+        return {f"r{k}/{wd.name}": wd.compile_count
+                for k, e in enumerate((e1, e2))
+                for wd in [e._decode, e._lane_install, e._copy_page,
+                           *e._prefill.values()]
+                if wd is not None}
+
+    compiles_before = compile_counts()
+    get_reqtrace().reset()  # warmup/reference traces are not part of the arm
+
+    router = ReplicaRouter([e1, e2], registry=registry, breaker_base_s=0.05)
+    fd = FrontDoor(router, model_name=f"bench-{preset}").start()
+    srv = ApiServer(fd, registry=registry)
+    host, port = srv.host, srv.port
+
+    def http_json(method, path, payload=None, timeout=600.0):
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            body = None if payload is None else json.dumps(payload)
+            headers = {} if payload is None else {
+                "Content-Type": "application/json"}
+            conn.request(method, path, body, headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            return resp.status, dict(resp.getheaders()), json.loads(raw)
+        finally:
+            conn.close()
+
+    def completion(i):
+        return http_json("POST", "/v1/completions", {
+            "prompt": [int(t) for t in prompts[i]],
+            "max_tokens": new_tokens, "temperature": 0,
+        })
+
+    def fanout(fn, work):
+        out = [None] * len(work)
+
+        def run(k, item):
+            try:
+                out[k] = fn(*item)
+            except Exception as exc:
+                out[k] = exc
+
+        threads = [threading.Thread(target=run, args=(k, item), daemon=True)
+                   for k, item in enumerate(work)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errs = [o for o in out if isinstance(o, Exception)]
+        if errs:
+            raise SystemExit(f"--trace-ab: client transport error: {errs[0]!r}")
+        return out
+
+    # ---- arm 1: traced workload + mid-generation kill — waterfall fidelity
+    killed = {}
+
+    def assassin():
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            for name, e in (("r1", e2), ("r0", e1)):
+                if e in router.engines and e._active.any():
+                    e.kill("trace-ab: injected mid-decode device loss")
+                    killed["replica"] = name
+                    return
+            time.sleep(0.002)
+
+    kt = threading.Thread(target=assassin, daemon=True)
+    kt.start()
+    t0 = time.perf_counter()
+    responses = fanout(completion, [(i,) for i in range(n)])
+    dt_traced = time.perf_counter() - t0
+    kt.join()
+    if "replica" not in killed:
+        raise SystemExit("--trace-ab: no replica ever had in-flight lanes "
+                         "to kill — the workload never got going")
+
+    failovers = 0
+    worst_attr_err = 0.0
+    for i, (status, headers, body) in enumerate(responses):
+        if status != 200:
+            raise SystemExit(f"--trace-ab: request {i} failed with HTTP "
+                             f"{status} after the replica kill: {body}")
+        got = body["choices"][0]["token_ids"]
+        if got != ref[i]:
+            raise SystemExit(
+                f"--trace-ab: request {i} returned {got[:8]}... != "
+                f"in-process reference {ref[i][:8]}... under tracing"
+            )
+        rid = headers.get("X-Request-Id")
+        if not rid:
+            raise SystemExit(f"--trace-ab: request {i} response carried no "
+                             "X-Request-Id header")
+        wstatus, _, wf = http_json("GET", f"/debug/requests/{rid}")
+        if wstatus != 200:
+            raise SystemExit(
+                f"--trace-ab: GET /debug/requests/{rid} -> {wstatus}; the "
+                "completed trace fell out of retention while addressable"
+            )
+        if wf["status"] != "done":
+            raise SystemExit(f"--trace-ab: request {i} trace status "
+                             f"{wf['status']!r} != 'done'")
+        ttft, attr = wf["ttft_s"], wf["ttft_attributed_s"]
+        err = abs(attr - ttft)
+        worst_attr_err = max(worst_attr_err, err / max(ttft, 1e-9))
+        if err > max(0.05 * ttft, 0.02):
+            raise SystemExit(
+                f"--trace-ab: request {i} ({rid}) phase sum {attr:.4f}s "
+                f"diverges from measured TTFT {ttft:.4f}s by more than "
+                "max(5%, 20ms) — the waterfall does not attribute latency"
+            )
+        if wf["failover"]:
+            failovers += 1
+            if len(wf["replicas"]) < 2:
+                raise SystemExit(
+                    f"--trace-ab: failover trace {rid} lists replicas "
+                    f"{wf['replicas']} — the trace did not span both"
+                )
+            if not any(p["phase"] == "failover" for p in wf["phase_list"]):
+                raise SystemExit(
+                    f"--trace-ab: failover trace {rid} has no 'failover' "
+                    "phase — adoption restarted the waterfall"
+                )
+    if failovers < 1:
+        raise SystemExit("--trace-ab: a replica died mid-generation but no "
+                         "completed trace records a failover — the trace "
+                         "did not ride export_inflight/adopt")
+    istatus, _, index = http_json("GET", "/debug/requests")
+    if istatus != 200:
+        raise SystemExit(f"--trace-ab: GET /debug/requests -> {istatus}")
+    if not index["slowest_ttft"] or not index["slowest_total"]:
+        raise SystemExit("--trace-ab: the slowest-K retention rings are "
+                         "empty after a full workload — tail-based "
+                         "retention is not retaining the tail")
+
+    t_end = time.monotonic() + 30.0
+    while time.monotonic() < t_end and len(router.engines) < 2:
+        time.sleep(0.01)
+    srv.stop()
+    fd.stop()
+
+    # ---- arm 2: tracing off — token identity + <= 1% interleaved overhead
+    reqtrace_mod.set_enabled(False)
+    try:
+        off_reqs = e1.serve(prompts, [gen] * n)
+    finally:
+        reqtrace_mod.set_enabled(None)
+    off_tokens = [[int(t) for t in q.tokens] for q in off_reqs]
+    if off_tokens != ref:
+        raise SystemExit("--trace-ab: tokens with tracing disabled diverge "
+                         "from the traced reference — the trace hooks "
+                         "touch the decode path")
+
+    # Overhead is measured as a NULL-CALIBRATED paired A/B.  Three arms
+    # rotate back to back per pair — tracing ON, tracing OFF, and a second
+    # tracing-off CONTROL with identical plumbing.  Each sample is the min
+    # of two consecutive serves (host contention is one-sided; the min
+    # filters the spike tail), and the pooled medians are re-checked after
+    # each sequential batch with early exit.  The gate is
+    #
+    #     median(on/off)  <=  1.01 + |median(ctl/off) - 1|
+    #
+    # i.e. tracing may cost at most 1% BEYOND what the instrument itself
+    # drifts between two IDENTICAL arms in the same run.  On a quiet host
+    # the control median sits at 1.000 and the gate is a strict 1%; on a
+    # host where two identical arms differ by 2%, a 1% verdict would be
+    # astrology — the demonstrated noise floor widens the gate by exactly
+    # what the null shows, and a real multi-percent regression still fails
+    # because the control does not move with the treatment.
+    # The arm runs on a FRESH replica with a reset registry: e1's
+    # post-kill state differs run to run (it may or may not be the revived
+    # victim), and the retention rings full of HTTP-arm traces were already
+    # hard-checked above — what this arm isolates is the steady marginal
+    # cost of tracing on a healthy replica.
+    # One more defence: pairs where EITHER sample sits far above its own
+    # arm's floor were hit by a contention burst mid-pair — both medians
+    # drop them (symmetrically, so a real regression cannot hide: a serve
+    # that is slower BECAUSE of tracing raises the on-arm floor itself and
+    # survives the trim).  The gate judges the uncontended regime, which
+    # is the regime "<= 1% overhead" is a statement about.
+    pairs_per_batch = 24
+    max_batches = 4
+    min_kept = 12
+    t_on, t_off, t_ctl = [], [], []
+    e3 = build()
+    e3.serve(warm, GenerationConfig(max_new_tokens=window))
+    get_reqtrace().reset()
+    for _ in range(2):  # discarded warm-up; also settles server teardown
+        e3.serve(prompts, [gen] * n)
+
+    def _timed(flag, sink):
+        reqtrace_mod.set_enabled(flag)
+        try:
+            best = None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                e3.serve(prompts, [gen] * n)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            sink.append(best)
+        finally:
+            reqtrace_mod.set_enabled(None)
+
+    def _median(vals):
+        vals = sorted(vals)
+        mid = len(vals) // 2
+        return (vals[mid] if len(vals) % 2
+                else 0.5 * (vals[mid - 1] + vals[mid]))
+
+    arms = [(True, t_on), (False, t_off), (False, t_ctl)]
+    med_ratio = null_ratio = allowance = None
+    for _ in range(max_batches):
+        for k in range(pairs_per_batch):
+            for flag, sink in arms[k % 3:] + arms[:k % 3]:
+                _timed(flag, sink)
+        lim_on = 1.25 * min(t_on)
+        lim_off = 1.25 * min(t_off)
+        lim_ctl = 1.25 * min(t_ctl)
+        kept = [(on, off, c) for on, off, c in zip(t_on, t_off, t_ctl)
+                if on <= lim_on and off <= lim_off and c <= lim_ctl]
+        if len(kept) < min_kept:
+            continue
+        med_ratio = _median([on / off for on, off, _ in kept])
+        null_ratio = _median([c / off for _, off, c in kept])
+        allowance = abs(null_ratio - 1.0)
+        if med_ratio <= 1.01 + allowance:
+            break
+    if med_ratio is None:
+        raise SystemExit(
+            f"--trace-ab: host contention too heavy to measure — fewer than "
+            f"{min_kept} of {len(t_on)} paired samples survived the burst "
+            f"trim; rerun on a quieter host"
+        )
+    if med_ratio > 1.01 + allowance:
+        raise SystemExit(
+            f"--trace-ab: tracing-on serve is {med_ratio - 1.0:+.1%} vs "
+            f"tracing-off (pooled median of {len(t_on)} paired min-of-2 "
+            f"samples after burst trim) while the off-vs-off control shows "
+            f"{null_ratio - 1.0:+.1%} instrument drift — tracing costs "
+            f">1% beyond the demonstrated noise floor; gate is <= "
+            f"{1.01 + allowance - 1.0:.1%}"
+        )
+
+    # ---- arm 3: tracing compiled nothing
+    compiles_after = compile_counts()
+    if compiles_after != compiles_before:
+        diff = {k: (compiles_before.get(k), v)
+                for k, v in compiles_after.items()
+                if compiles_before.get(k) != v}
+        raise SystemExit(f"--trace-ab: tracing compiled new executables "
+                         f"(name: before -> after): {diff}")
+
+    traced_tps = useful_tokens / dt_traced
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "requests": n,
+        "num_slots": slots,
+        "decode_window": window,
+        "new_tokens_per_request": new_tokens,
+        "useful_tokens": useful_tokens,
+        "traced_wall_s": round(dt_traced, 3),
+        "inproc_wall_s": round(dt_inproc, 3),
+        "inproc_tokens_per_s": round(useful_tokens / dt_inproc, 2),
+        "waterfall": {
+            "killed_replica": killed["replica"],
+            "outputs_token_identical": True,   # hard-checked above
+            "failover_traces": failovers,
+            "worst_ttft_attribution_error": round(worst_attr_err, 4),
+            "slowest_ttft_retained": len(index["slowest_ttft"]),
+            "slowest_total_retained": len(index["slowest_total"]),
+        },
+        "off": {
+            "pairs": len(t_on),
+            "outputs_token_identical": True,   # hard-checked above
+            "on_best_s": round(min(t_on), 4),
+            "off_best_s": round(min(t_off), 4),
+            "on_vs_off_median": round(med_ratio, 4),
+            "off_vs_off_control_median": round(null_ratio, 4),
+            "gate": round(1.01 + allowance, 4),
+            "new_executables": 0,              # hard-checked above
+        },
+    }
+    return {
+        "metric": "traced_serving_tokens_per_sec",
+        "value": round(traced_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(traced_tps / (useful_tokens / dt_inproc), 3),
+        "detail": detail,
+    }
+
+
 def _hier_ab_bench(args, model, cfg, params, preset):
     """Hierarchical prefix cache A/B: host-RAM spill tier on vs off.
 
@@ -2252,13 +2625,14 @@ def _serve_bench(args, model, cfg, params, preset):
             bool(getattr(args, "async_ab", False)),
             bool(getattr(args, "http_ab", False)),
             bool(getattr(args, "chaos_ab", False)),
+            bool(getattr(args, "trace_ab", False)),
             bool(getattr(args, "prefill_ab", False)),
             bool(getattr(args, "hier_ab", False)),
             bool(args.shared_prefix)]) > 1:
         raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab, "
-                         "--http-ab, --chaos-ab, --prefill-ab, --hier-ab "
-                         "and --shared-prefix are separate serve workloads; "
-                         "pick one")
+                         "--http-ab, --chaos-ab, --trace-ab, --prefill-ab, "
+                         "--hier-ab and --shared-prefix are separate serve "
+                         "workloads; pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "hier_ab", False):
@@ -2267,6 +2641,8 @@ def _serve_bench(args, model, cfg, params, preset):
         return _http_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "chaos_ab", False):
         return _chaos_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "trace_ab", False):
+        return _trace_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "kernel_ab", False):
         return _kernel_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "prefill_ab", False):
@@ -2496,6 +2872,16 @@ def main():
                              "driver crashes), then prove faults-off costs "
                              "nothing (<=1%% A/B, zero new executables; all "
                              "hard checks)")
+    parser.add_argument("--trace-ab", dest="trace_ab", action="store_true",
+                        help="--task serve: gate per-request tracing — kill a "
+                             "replica mid-generation and require every "
+                             "response's X-Request-Id to resolve to a "
+                             "waterfall whose phase sum matches its TTFT "
+                             "within 5%%, a failover trace spanning both "
+                             "replicas, populated slowest-K retention, "
+                             "token-identity traces on vs off, <=1%% paired "
+                             "overhead, and an unchanged compiled-executable "
+                             "budget (all hard checks)")
     parser.add_argument("--prefill-ab", dest="prefill_ab", action="store_true",
                         help="--task serve: A/B the flash-prefill kernel and "
                              "decode-interleaved chunked prefill against the "

@@ -1,12 +1,16 @@
-"""Rule ``metric-docs``: the observability doc and the metric registry agree
-in BOTH directions.
+"""Rule ``metric-docs``: the observability doc and the telemetry surface agree
+in BOTH directions — for registry metrics AND for span/flight-event names.
 
 Forward (ported from ``tools/check_metric_docs.py``): any literal metric name
 passed to ``registry.counter(...)``, ``registry.gauge(...)`` or
 ``registry.histogram(...)`` inside ``accelerate_tpu/`` must appear verbatim
 in ``docs/usage/observability.md`` — the doc is the operator-facing contract
 for what a ``/metrics`` scrape can contain, and an undocumented gauge is
-invisible to whoever has to build the dashboard.
+invisible to whoever has to build the dashboard.  The same holds for
+namespaced span and flight-event names (``tracer.span("serve/...")``,
+``recorder.record("serve/...")``, ``recorder.heartbeat("serve/...")``): an
+undocumented event kind is noise to whoever reads a ``/debug/flight`` ring
+during an incident.
 
 Reverse (new with the port — the old script was asymmetric): every concrete
 metric name in the doc's metric table must still be emitted somewhere, or the
@@ -15,11 +19,14 @@ no longer exists.  A doc name counts as emitted when it matches a literal
 registration OR a dynamic f-string family (``f"serve/{k}_total"`` matches
 ``serve/preemptions_total``).  Doc names carrying ``*`` or ``<`` are
 documented patterns and skipped; so are names outside the table's metrics
-column (the spans column names tracer spans, not registry series).
+column.  Span/flight-event names get the same orphan check against the doc's
+"Span & flight-event index" section: its table rows (first cell) must each
+match a ``span``/``record``/``heartbeat`` literal still in the tree.
 
 Only string-literal (or f-string) first arguments are checked; names built
-from opaque variables are skipped.  ``# noqa: metric-docs`` on the
-registration line exempts it.
+from opaque variables are skipped, as are un-namespaced span names (no
+``/``, e.g. ``span("phase")`` in examples).  ``# noqa: metric-docs`` on the
+emitting line exempts it.
 
 The orphan direction runs only when the whole ``accelerate_tpu`` package is
 on the lint surface: on a partial run (``python -m tools.atpu_lint
@@ -35,7 +42,9 @@ from typing import List, Tuple
 from ..core import Diagnostic, Rule
 
 FACTORIES = ("counter", "gauge", "histogram")
+EVENT_EMITTERS = ("span", "record", "heartbeat")
 _CONCRETE = re.compile(r"[a-z0-9_]+(?:/[a-z0-9_]+)+")
+_EVENT_SECTION = "span & flight-event index"
 
 
 class MetricDocsRule(Rule):
@@ -45,37 +54,56 @@ class MetricDocsRule(Rule):
     def __init__(self):
         self._literals: List[Tuple[str, int, str, str]] = []  # rel, line, kind, name
         self._patterns: List[re.Pattern] = []
+        self._event_literals: List[Tuple[str, int, str, str]] = []
+        self._event_patterns: List[re.Pattern] = []
 
     def applies_to(self, rel: str) -> bool:
         return rel.startswith("accelerate_tpu/")
 
     def visit(self, tree, src, ctx) -> List[Diagnostic]:
         for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in FACTORIES
-                and node.args
-            ):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                # the module-level ``span("...")`` helper from telemetry
+                attr = node.func.id if node.func.id == "span" else None
+            else:
                 continue
             first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(first.value, str):
-                self._literals.append((ctx.rel, node.lineno, node.func.attr, first.value))
-            elif isinstance(first, ast.JoinedStr):
-                parts = []
-                for piece in first.values:
-                    if isinstance(piece, ast.Constant):
-                        parts.append(re.escape(str(piece.value)))
-                    else:
-                        parts.append(r".+")
-                self._patterns.append(re.compile("".join(parts)))
+            if attr in FACTORIES:
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    self._literals.append((ctx.rel, node.lineno, attr, first.value))
+                elif isinstance(first, ast.JoinedStr):
+                    self._patterns.append(self._joined_pattern(first))
+            elif attr in EVENT_EMITTERS:
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    # only namespaced names are part of the contract — bare
+                    # span names ("phase", function qualnames) are ad hoc
+                    if _CONCRETE.fullmatch(first.value):
+                        self._event_literals.append(
+                            (ctx.rel, node.lineno, attr, first.value)
+                        )
+                elif isinstance(first, ast.JoinedStr):
+                    self._event_patterns.append(self._joined_pattern(first))
         return []
+
+    @staticmethod
+    def _joined_pattern(node: ast.JoinedStr) -> re.Pattern:
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(re.escape(str(piece.value)))
+            else:
+                parts.append(r".+")
+        return re.compile("".join(parts))
 
     def finalize(self, project) -> List[Diagnostic]:
         doc_rel = project.observability_doc
         doc_path = project.root / doc_rel
         if not doc_path.exists():
-            if not self._literals:
+            if not self._literals and not self._event_literals:
                 return []
             return [Diagnostic(doc_rel, 1, self.id, f"missing {doc_rel}")]
         doc_text = doc_path.read_text()
@@ -85,6 +113,12 @@ class MetricDocsRule(Rule):
                 out.append(Diagnostic(
                     rel, lineno, self.id,
                     f"{kind} '{name}' is not documented in {doc_rel}",
+                ))
+        for rel, lineno, kind, name in self._event_literals:
+            if name not in doc_text:
+                out.append(Diagnostic(
+                    rel, lineno, self.id,
+                    f"{kind} event '{name}' is not documented in {doc_rel}",
                 ))
         if not self._covers_package(project):
             return out
@@ -96,6 +130,18 @@ class MetricDocsRule(Rule):
                 doc_rel, lineno, self.id,
                 f"orphan doc row: metric '{name}' is documented but no longer "
                 "emitted by any registry.counter/gauge/histogram call",
+                src_line=name,
+            ))
+        event_names = {name for _, _, _, name in self._event_literals}
+        for lineno, name in self._event_index_names(doc_text):
+            if name in event_names or any(
+                p.fullmatch(name) for p in self._event_patterns
+            ):
+                continue
+            out.append(Diagnostic(
+                doc_rel, lineno, self.id,
+                f"orphan doc row: span/flight-event '{name}' is documented "
+                "but no longer emitted by any span/record/heartbeat call",
                 src_line=name,
             ))
         return out
@@ -121,15 +167,44 @@ class MetricDocsRule(Rule):
     def _doc_table_names(doc_text: str) -> List[Tuple[int, str]]:
         """Concrete metric names in the metrics column (cell 2) of markdown
         table rows.  Backticked tokens with ``*``/``<`` are documented
-        dynamic families, not concrete names."""
+        dynamic families, not concrete names.  Rows inside the span/event
+        index section belong to :meth:`_event_index_names`, not here."""
         found = []
+        in_event_section = False
         for i, line in enumerate(doc_text.splitlines(), start=1):
-            if not line.lstrip().startswith("|"):
+            if line.startswith("#"):
+                in_event_section = _EVENT_SECTION in line.lower()
+                continue
+            if in_event_section or not line.lstrip().startswith("|"):
                 continue
             cells = line.split("|")
             if len(cells) < 4:
                 continue
             for m in re.finditer(r"`([^`]+)`", cells[2]):
+                token = m.group(1)
+                if "*" in token or "<" in token:
+                    continue
+                if _CONCRETE.fullmatch(token):
+                    found.append((i, token))
+        return found
+
+    @staticmethod
+    def _event_index_names(doc_text: str) -> List[Tuple[int, str]]:
+        """Concrete span/flight-event names from the doc's "Span &
+        flight-event index" section: the first backticked token of each table
+        row's first cell, until the next heading."""
+        found = []
+        in_section = False
+        for i, line in enumerate(doc_text.splitlines(), start=1):
+            if line.startswith("#"):
+                in_section = _EVENT_SECTION in line.lower()
+                continue
+            if not in_section or not line.lstrip().startswith("|"):
+                continue
+            cells = line.split("|")
+            if len(cells) < 3:
+                continue
+            for m in re.finditer(r"`([^`]+)`", cells[1]):
                 token = m.group(1)
                 if "*" in token or "<" in token:
                     continue
