@@ -59,6 +59,7 @@ bench-serve:
 	python bench_inference.py --task serve --chaos-ab
 	python bench_inference.py --task serve --trace-ab
 	python bench_inference.py --task spec
+	python bench_inference.py --task spec --tree-ab
 
 # fault-tolerance gate: the deterministic fault-injection test suite plus the
 # chaos A/B (replica kill -> token-identical replay, seeded fault soak, and a

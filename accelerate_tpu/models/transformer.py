@@ -353,7 +353,8 @@ class PagedKVCache(struct.PyTreeNode):
         return self.tables.shape[1] * self.pages_k.shape[2]
 
 
-def cached_attention(q, k, v, q_positions, window=None, alibi=False):
+def cached_attention(q, k, v, q_positions, window=None, alibi=False,
+                     tree_mask=None):
     """Attention of ``q`` [B,S,Hq,D] against a full cache ``k``/``v`` [B,M,Hkv,D].
 
     Key slot ``j`` is visible to query ``i`` iff ``j <= q_positions[i]`` —
@@ -365,6 +366,17 @@ def cached_attention(q, k, v, q_positions, window=None, alibi=False):
     groups fold into the query tensor (``[B,S,Hkv,rep,D]``) so the cache is
     contracted UNexpanded — a ``jnp.repeat`` of K/V would multiply the
     per-token HBM reads by the query/kv head ratio on the decode hot path.
+
+    ``tree_mask`` switches the causal row mask to *token-tree* visibility for
+    speculative tree verification: an ``[S, S]`` ancestor-or-self boolean
+    (compile-time constant, ``tree_mask[i, j]`` = query node ``i`` may see
+    tree node ``j``).  The ``S`` tree nodes occupy consecutive cache slots
+    starting at each lane's pre-call frontier ``q_positions[:, 0]`` (node 0
+    is the lane's pending token, so its depth — and position offset — is 0);
+    node ``i`` then sees all committed history ``j < frontier`` plus exactly
+    its own root-to-self chain inside the tree span.  Mutually exclusive with
+    ``window``/``alibi`` (the engine only builds tree windows for full-causal
+    rope/learned models).
     """
     b, s, n_q, d = q.shape
     n_kv = k.shape[2]
@@ -373,6 +385,25 @@ def cached_attention(q, k, v, q_positions, window=None, alibi=False):
     scale = d ** -0.5
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32) * scale
     j = jnp.arange(k.shape[1])
+    if tree_mask is not None:
+        if window is not None or alibi:
+            raise ValueError(
+                "tree_mask needs a full-causal model: sliding_window and "
+                "alibi are not supported under tree verification"
+            )
+        tm = jnp.asarray(tree_mask, bool)               # [S, S] constant
+        base = q_positions[:, 0]                        # [B] lane frontier
+        rel = j[None, :] - base[:, None]                # [B, M] slot -> node id
+        within = (rel >= 0) & (rel < s)
+        anc = tm[:, jnp.clip(rel, 0, s - 1)]            # [S, B, M]
+        allowed = (j[None, None, :] < base[:, None, None]) | (
+            within[:, None, :] & jnp.transpose(anc, (1, 0, 2))
+        )                                               # [B, S, M]
+        mask = allowed[:, None, None, :, :]             # [B,1,1,S,M]
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
+        return out.reshape(b, s, n_q, d)
     if alibi:
         rel = (j[None, None, None, None, :]
                - q_positions[:, None, None, :, None]).astype(jnp.float32)
@@ -519,11 +550,14 @@ class Attention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, cache=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None,
+                 tree_mask=None):
         """``cache`` is ``(k_cache [B,M,Hkv,D], v_cache, index)`` for this layer;
         when given, new k/v are written at ``index`` (post-rope, so cached keys
         never need re-rotation) and the call returns ``(out, (new_k_cache,
-        new_v_cache))``."""
+        new_v_cache))``.  ``tree_mask`` (an ``[S, S]`` ancestor-or-self numpy
+        constant, ``S == x.shape[1]``) switches the cache-read mask to token-
+        tree visibility for speculative tree verification — cache required."""
         cfg = self.config
         hd = cfg.resolved_head_dim
         dense = functools_partial_dense(cfg, use_bias=cfg.attn_bias)
@@ -575,8 +609,14 @@ class Attention(nn.Module):
                 out = paged_attention(
                     q, pages_k, pages_v, tables, index,
                     k_scales=sk, v_scales=sv, interpret=cfg.paged_interpret,
+                    tree_mask=tree_mask,
                 )
             elif cfg.paged_kernel == "flash_prefill":
+                if tree_mask is not None:
+                    raise ValueError(
+                        "tree verification is a decode-side program; "
+                        "paged_kernel='flash_prefill' cannot carry a tree_mask"
+                    )
                 out = paged_flash_prefill(
                     q, pages_k, pages_v, tables, index,
                     k_scales=sk, v_scales=sv, interpret=cfg.paged_interpret,
@@ -585,7 +625,7 @@ class Attention(nn.Module):
                 out = paged_attention_reference(
                     q, pages_k, pages_v, tables, index,
                     k_scales=sk, v_scales=sv, window=cfg.sliding_window,
-                    alibi=cfg.positional == "alibi",
+                    alibi=cfg.positional == "alibi", tree_mask=tree_mask,
                 )
             out = out.reshape(b, s, cfg.num_heads * hd)
             return dense("o_proj", cfg.hidden_size)(out), (
@@ -611,9 +651,12 @@ class Attention(nn.Module):
                 v_cache = jax.vmap(_write)(v_cache, v.astype(v_cache.dtype), index)
             out = cached_attention(q, k_cache, v_cache, positions,
                                    window=cfg.sliding_window,
-                                   alibi=cfg.positional == "alibi")
+                                   alibi=cfg.positional == "alibi",
+                                   tree_mask=tree_mask)
             out = out.reshape(b, s, cfg.num_heads * hd)
             return dense("o_proj", cfg.hidden_size)(out), (k_cache, v_cache)
+        if tree_mask is not None:
+            raise ValueError("tree_mask requires a KV cache (verify window)")
         bias = None
         if cfg.positional == "alibi":
             bias = _alibi_bias(cfg.num_heads, s)
@@ -700,10 +743,12 @@ class DecoderLayer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache=None):
+    def __call__(self, x, positions, cache=None, tree_mask=None):
         cfg = self.config
         normed = make_norm(cfg, "input_norm")(x)
-        attn_out = Attention(cfg, name="attn")(normed, positions, cache=cache)
+        attn_out = Attention(cfg, name="attn")(
+            normed, positions, cache=cache, tree_mask=tree_mask
+        )
         new_kv = None
         if cache is not None:
             attn_out, new_kv = attn_out
@@ -736,8 +781,18 @@ class Transformer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, cache: Optional[KVCache] = None):
+    def __call__(self, input_ids, positions=None, cache: Optional[KVCache] = None,
+                 tree_mask=None):
         cfg = self.config
+        # Token-tree verification (serving/spec_exec.py): ``tree_mask`` is the
+        # [S, S] ancestor-or-self constant; each layer's attention swaps the
+        # causal row mask for tree visibility over the S-node span written at
+        # the lane frontier.  Positions must then be passed explicitly
+        # (frontier + node depth) — the arange default below would assign
+        # sibling branches consecutive positions.
+        if tree_mask is not None and positions is None:
+            raise ValueError("tree_mask requires explicit positions "
+                             "(lane frontier + per-node tree depth)")
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1])[None, :], input_ids.shape
@@ -786,7 +841,7 @@ class Transformer(nn.Module):
                 variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
-                in_axes=(nn.broadcast, nn.broadcast, 0),
+                in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast),
             )
             if cache is None:
                 kv_in, bcast = (None, None), None
@@ -797,7 +852,9 @@ class Transformer(nn.Module):
                 bcast = (cache.tables, cache.index, cache.active)
             else:
                 kv_in, bcast = (cache.k, cache.v), cache.index
-            x, kv_out = ScanLayers(cfg, name="layers")(x, positions, bcast, kv_in)
+            x, kv_out = ScanLayers(cfg, name="layers")(
+                x, positions, bcast, kv_in, tree_mask
+            )
             if isinstance(cache, PagedKVCache):
                 new_cache = cache.replace(
                     pages_k=kv_out[0], pages_v=kv_out[1],
@@ -826,6 +883,7 @@ class Transformer(nn.Module):
                         cache=(cache.pages_k[i], cache.pages_v[i],
                                cache.k_scales[i], cache.v_scales[i],
                                cache.tables, cache.index, cache.active),
+                        tree_mask=tree_mask,
                     )
                     new_ks.append(pk_i)
                     new_vs.append(pv_i)
@@ -834,7 +892,8 @@ class Transformer(nn.Module):
                     errs.append(err_i)
                 else:
                     x, (k_i, v_i) = layer_cls(cfg, name=f"layers_{i}")(
-                        x, positions, cache=(cache.k[i], cache.v[i], cache.index)
+                        x, positions, cache=(cache.k[i], cache.v[i], cache.index),
+                        tree_mask=tree_mask,
                     )
                     new_ks.append(k_i)
                     new_vs.append(v_i)
@@ -877,16 +936,19 @@ class ScanBody(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache_index=None, kv=(None, None)):
+    def __call__(self, x, positions, cache_index=None, kv=(None, None),
+                 tree_mask=None):
         layer = DecoderLayer(self.config, name="layer")
         if kv[0] is None:
             return layer(x, positions), None
         if len(kv) == 4:
             # paged: kv = per-layer (pages_k, pages_v, k_scales, v_scales),
             # cache_index = broadcast (tables, index, active)
-            x, new_kv = layer(x, positions, cache=tuple(kv) + tuple(cache_index))
+            x, new_kv = layer(x, positions, cache=tuple(kv) + tuple(cache_index),
+                              tree_mask=tree_mask)
             return x, new_kv
-        x, new_kv = layer(x, positions, cache=(kv[0], kv[1], cache_index))
+        x, new_kv = layer(x, positions, cache=(kv[0], kv[1], cache_index),
+                          tree_mask=tree_mask)
         return x, new_kv
 
 

@@ -46,6 +46,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -99,11 +100,13 @@ def resolve_paged_kernel(kernel: str, mesh=None, tp_axis: str = "tp",
     the requested kernel.
 
     ``role`` names which pool program is being resolved — ``"decode"``
-    (:func:`paged_attention`) or ``"prefill"`` (:func:`paged_flash_prefill`).
-    Both kernels walk the same head-sharded page pool through the same
-    scalar-prefetched block tables, so the fallback condition is identical;
-    the arm exists so no caller can route prefill around the sharding check."""
-    if role not in ("decode", "prefill"):
+    (:func:`paged_attention`), ``"prefill"`` (:func:`paged_flash_prefill`) or
+    ``"tree_verify"`` (the decode kernel carrying a token-tree ancestor mask
+    for speculative tree verification).  All walk the same head-sharded page
+    pool through the same scalar-prefetched block tables, so the fallback
+    condition is identical; the arms exist so no caller can route any of them
+    around the sharding check."""
+    if role not in ("decode", "prefill", "tree_verify"):
         raise ValueError(f"unknown paged-kernel role {role!r}")
     if kernel != "pallas" or mesh is None:
         return kernel
@@ -203,7 +206,7 @@ def paged_quantized_insert(pages, scales, new, tables, index, active,
 # ------------------------------------------------------------------ reference
 def paged_attention_reference(q, pages_k, pages_v, tables, lengths,
                               k_scales=None, v_scales=None, window=None,
-                              alibi: bool = False):
+                              alibi: bool = False, tree_mask=None):
     """Pure-XLA oracle/fallback: live-masked gather + the slab attention math.
 
     ``q [N, S, Hq, D]`` against pages ``[NP, page, Hkv, D]`` through
@@ -213,7 +216,15 @@ def paged_attention_reference(q, pages_k, pages_v, tables, lengths,
     live page count gather the null page instead of whole stale pages — the
     gather moves only pages that can contain visible keys, and since masked
     positions never reach the softmax the native-dtype output is bitwise
-    identical to the full gather (and so to the slab pool)."""
+    identical to the full gather (and so to the slab pool).
+
+    ``tree_mask`` (``[S, S]`` ancestor-or-self constant) switches the row
+    mask to token-tree visibility for speculative tree verification: the
+    ``S`` queries are tree *nodes* written at slots ``lengths[n] ..
+    lengths[n] + S - 1``, each seeing committed history plus its own
+    root-to-self chain.  The live-page arithmetic is unchanged — all tree
+    slots fall inside the same ``lengths + S - 1`` frontier a linear verify
+    window spans."""
     from ..models.transformer import cached_attention
 
     n, s, _, d = q.shape
@@ -233,20 +244,32 @@ def paged_attention_reference(q, pages_k, pages_v, tables, lengths,
     k = k.reshape(n, num_p * page, hkv, d)
     v = v.reshape(n, num_p * page, hkv, d)
     q_positions = lengths[:, None] + jnp.arange(s)[None, :]
-    return cached_attention(q, k, v, q_positions, window=window, alibi=alibi)
+    return cached_attention(q, k, v, q_positions, window=window, alibi=alibi,
+                            tree_mask=tree_mask)
 
 
 # --------------------------------------------------------------------- kernel
 def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
                        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                       page: int, s_len: int, scale: float, quantized: bool):
+                       page: int, s_len: int, scale: float, quantized: bool,
+                       tree_words=None):
     """One (lane, kv-head, page) step of the online softmax.
 
     Row ``r`` of the folded query block holds query head ``h * rep + r //
     s_len`` at sequence position ``lengths[lane] + r % s_len``.  The page loop
     is the innermost grid dimension, so m/l/acc VMEM scratch carries across
     it; pages at or past the lane's live count are skipped (their block index
-    degenerates to the null page, which the pipeline fetched at most once)."""
+    degenerates to the null page, which the pipeline fetched at most once).
+
+    ``tree_words`` (a tuple of ``s_len`` Python ints — node ``i``'s uint32
+    ancestor word) switches the causal row mask to token-tree visibility:
+    bit ``j`` of node ``i``'s word says whether ``i`` may see tree node ``j``
+    (ancestor-or-self), where node ``j`` occupies slot ``lengths[lane] + j``.
+    The words are baked in as SCALAR immediates (Pallas rejects captured
+    array constants) and selected per query row by an iota-compare chain —
+    at most 32 selects, folded at compile time.  History slots
+    (``j < length``) stay visible to every node — the page walk and online
+    softmax are untouched, only the mask predicate changes."""
     lane, p = pl.program_id(0), pl.program_id(2)
     n_p = pl.num_programs(2)
     gs = acc_ref.shape[0]
@@ -274,8 +297,22 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32,
         )                                                      # [GS, page]
         j = p * page + jax.lax.broadcasted_iota(jnp.int32, (gs, page), 1)
-        qi = jax.lax.broadcasted_iota(jnp.int32, (gs, page), 0) % s_len
-        s = jnp.where(j <= length + qi, s, DEFAULT_MASK_VALUE)
+        if tree_words is None:
+            qi = jax.lax.broadcasted_iota(jnp.int32, (gs, page), 0) % s_len
+            s = jnp.where(j <= length + qi, s, DEFAULT_MASK_VALUE)
+        else:
+            # token-tree mask: key slot j holds tree node rel = j - length;
+            # visible iff committed history (j < length) or bit rel of this
+            # row's ancestor word is set (row r = group-major fold, node
+            # r % s_len; the word materializes from scalar immediates)
+            node = jax.lax.broadcasted_iota(jnp.int32, (gs, page), 0) % s_len
+            word = jnp.zeros((gs, page), jnp.uint32)
+            for idx, w in enumerate(tree_words):
+                word = jnp.where(node == idx, jnp.uint32(w), word)
+            rel = j - length
+            in_tree = (rel >= 0) & (rel < s_len)
+            anc = ((word >> jnp.clip(rel, 0, 31).astype(jnp.uint32)) & 1) == 1
+            s = jnp.where((j < length) | (in_tree & anc), s, DEFAULT_MASK_VALUE)
 
         if page >= NUM_LANES:
             lane_bcast = lambda a: jnp.tile(a[:, :1], (1, page))
@@ -314,7 +351,8 @@ def acc_bcast_store(a, head_dim: int):
 
 
 def paged_attention(q, pages_k, pages_v, tables, lengths, k_scales=None,
-                    v_scales=None, interpret: Optional[bool] = None):
+                    v_scales=None, interpret: Optional[bool] = None,
+                    tree_mask=None):
     """Decode attention over paged KV, reading pages in place.
 
     Parameters
@@ -333,6 +371,12 @@ def paged_attention(q, pages_k, pages_v, tables, lengths, k_scales=None,
     interpret: run the kernel in pallas interpret mode (defaults to True off
         TPU — the CPU testing discipline shared with
         :mod:`.flash_attention`).
+    tree_mask: ``[S, S]`` ancestor-or-self boolean (host numpy constant) for
+        speculative tree verification — query ``i`` is tree node ``i`` at slot
+        ``lengths[n] + i`` and sees history plus its root-to-self chain.  The
+        mask is packed to one uint32 ancestor word per folded query row and
+        baked into the kernel (``S <= 32``), so the executable is specialized
+        per tree topology exactly as it already is per ``S``.
 
     Returns ``[N, S, Hq, D]`` in ``q.dtype``.  Grid: one program per
     (lane, kv-head) marching over the lane's pages innermost; GQA query heads
@@ -345,6 +389,21 @@ def paged_attention(q, pages_k, pages_v, tables, lengths, k_scales=None,
     num_p = tables.shape[1]
     rep = hq // hkv
     gs = rep * s
+    tree_words = None
+    if tree_mask is not None:
+        tm = np.asarray(tree_mask, dtype=bool)
+        if tm.shape != (s, s):
+            raise ValueError(f"tree_mask {tm.shape} must be [S, S] = [{s}, {s}]")
+        if s > 32:
+            raise ValueError(
+                f"pallas tree verification packs ancestor sets into uint32 "
+                f"words: {s} tree nodes > 32 (use the xla reference)"
+            )
+        bits = (tm.astype(np.uint32)
+                << np.arange(s, dtype=np.uint32)[None, :]).sum(axis=1)
+        # plain Python ints: baked into the kernel as scalar immediates (an
+        # array here would be a captured constant, which Pallas rejects)
+        tree_words = tuple(int(w) for w in bits)
     quantized = kv_qmax(pages_k.dtype) is not None
     if quantized and (k_scales is None or v_scales is None):
         raise ValueError("quantized pages need k_scales/v_scales")
@@ -384,6 +443,7 @@ def paged_attention(q, pages_k, pages_v, tables, lengths, k_scales=None,
     kernel = functools.partial(
         _paged_attn_kernel,
         page=page, s_len=s, scale=d ** -0.5, quantized=quantized,
+        tree_words=tree_words,
     )
     out = pl.pallas_call(
         kernel,

@@ -15,16 +15,30 @@ against a fixed set of compiled executables (:mod:`.pool`):
 Everything dynamic lives on the host; the device only ever sees
 ``1 + len(prefill_buckets) + 1`` shapes (decode window, per-bucket prefill,
 insert), plus ``len(prefill_buckets)`` fixed copy shapes when the prefix
-cache is enabled, plus one verify-window shape when ``speculate_k > 0``.
+cache is enabled, plus one verify-window shape when ``speculate_k > 0``
+(or a tree-verify + draft-forward pair when ``draft_model`` is set).
 See ``docs/usage/serving.md``.
 
 Speculative decoding (``speculate_k > 0``): each cycle the host proposes K
-draft tokens per lane by n-gram prompt-lookup (:mod:`.spec`) and, when at
-least one lane drafts, ONE verify forward over ``[slots, K+1]`` positions
+draft tokens per lane by n-gram prompt-lookup (:mod:`.spec` — incrementally
+indexed per lane, O(K) per cycle) and, when at least one lane drafts, ONE
+verify forward over ``[slots, K+1]`` positions
 (:func:`.pool.make_verify_window`) lands 1..K+1 tokens per lane — greedy
 outputs token-exact vs plain decode, sampled outputs distribution-exact
 (Leviathan accept/resample).  Cycles with no draft fall back to the decode
 window, so non-repetitive workloads never regress.
+
+Tree speculation (``draft_model=``): an on-device draft model — by default a
+truncated-layer head of the served model (:func:`.spec_exec.build_draft`) —
+drafts a ``1 + tree_width * tree_depth``-node token tree per lane in ONE
+small jitted forward (:func:`.spec_exec.make_draft_forward`), and a tree
+verify window (:func:`.pool.make_tree_verify_window`) scores all nodes under
+the ancestor attention mask and commits the best root-to-leaf path:
+Leviathan acceptance generalized to branch selection, so outputs stay
+token-exact (greedy) / distribution-exact (sampled).  Unlike n-gram lookup,
+the draft model speculates on *non-repetitive* text; the compiled budget
+grows by exactly two shapes: ``draft_forward`` and ``tree_verify_window``
+(which replaces the linear verify window).  See ``docs/usage/serving.md``.
 
 Prefix caching (:mod:`.prefix_cache`): freshly prefilled full chunks are
 retained as device KV slabs in a radix tree keyed by the token prefix; later
@@ -62,7 +76,7 @@ from ..telemetry import (
 )
 from . import faults
 from .errors import AdmissionError
-from .paging import PagedKVPool
+from .paging import DraftContextWindow, PagedKVPool
 from .pool import (
     ServeShardings,
     audit_donation,
@@ -74,17 +88,25 @@ from .pool import (
     make_lane_install,
     make_paged_decode_window,
     make_paged_prefill_chunk,
+    make_paged_tree_verify_window,
     make_paged_verify_window,
     make_prefill_chunk,
     make_promote_install,
     make_spill_extract,
+    make_tree_verify_window,
     make_verify_window,
     plan_chunks,
 )
 from .prefix_cache import PrefixCache
 from .readback import Readback, fetch
 from .scheduler import Request, RequestState, Scheduler
-from .spec import propose_ngram_draft
+from .spec_exec import (
+    NgramDrafter,
+    TreeDrafter,
+    TreeSpec,
+    build_draft,
+    make_draft_forward,
+)
 
 logger = get_logger(__name__)
 
@@ -160,6 +182,26 @@ class ServingEngine:
         Per-request opt-out: ``submit(..., speculate=False)``.
     speculate_ngram: longest trailing n-gram the draft proposer tries
         (:func:`~accelerate_tpu.serving.spec.propose_ngram_draft`).
+    draft_model: switch speculation to an on-device draft model verified
+        over a token tree.  ``int n`` — self-speculation: the first ``n``
+        layers of the served model (re-sliced on every :meth:`swap_params`);
+        ``str path`` — a HF checkpoint dir streamed through
+        :mod:`~accelerate_tpu.models.hf_compat` (optionally ``"dir#n"`` to
+        truncate to ``n`` layers); ``(cfg, params)`` — an explicit pre-built
+        draft.  Replaces the linear verify window with the tree verify
+        window plus one draft-forward executable; requires a full-causal
+        model (no sliding window / alibi).
+    tree_width: sibling branches at the tree's branch point (draft-model
+        top-k candidates); ``1`` (default) drafts a single greedy chain —
+        the linear window shape, still verified through the tree machinery.
+        Requires ``draft_model``.
+    tree_depth: draft chain length below each branch candidate; defaults to
+        ``speculate_k`` when set, else 4.  The tree verifies
+        ``1 + tree_width * tree_depth`` nodes per lane and commits at most
+        ``tree_depth + 1`` tokens.  Under ``decode_kernel="pallas"`` the
+        node count must stay <= 32 (ancestor masks pack into uint32 rows).
+    draft_ctx: host-side sliding context window the stateless draft forward
+        re-prefills each cycle (:class:`~.paging.DraftContextWindow`).
     metrics_port: start (or join) the process-wide debug server
         (``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``)
         on this port; ``0`` binds an ephemeral port, ``None`` defers to
@@ -306,6 +348,10 @@ class ServingEngine:
         metrics_port: Optional[int] = None,
         speculate_k: int = 0,
         speculate_ngram: int = 3,
+        draft_model: Any = None,
+        tree_width: int = 1,
+        tree_depth: Optional[int] = None,
+        draft_ctx: int = 64,
         paged: bool = False,
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
@@ -423,6 +469,45 @@ class ServingEngine:
         # the prefill-side twin of the flag: quantized pools and the flash
         # prefill kernel both need the chunk forward to own the page writes
         self._prefill_direct = self.quantized or self.prefill_kernel == "pallas"
+        # ------------------------------------------------- tree speculation
+        self._draft_spec = draft_model
+        self.tree_width = int(tree_width)
+        self.tree_depth = int(
+            tree_depth if tree_depth is not None
+            else (self.speculate_k if self.speculate_k else 4)
+        )
+        self.draft_ctx = int(draft_ctx)
+        self.tree: Optional[TreeSpec] = None
+        if draft_model is None:
+            if self.tree_width != 1:
+                raise ValueError(
+                    "tree_width > 1 needs a draft model to rank sibling "
+                    "branches; pass draft_model="
+                )
+        else:
+            if self.draft_ctx < 1:
+                raise ValueError(f"draft_ctx must be >= 1, got {draft_ctx}")
+            if cfg.sliding_window is not None or cfg.positional == "alibi":
+                raise ValueError(
+                    "tree speculation needs a full-causal model: the ancestor "
+                    "mask replaces the causal row mask, which sliding_window "
+                    "and alibi models reshape"
+                )
+            self.tree = TreeSpec(self.tree_width, self.tree_depth)
+            if decode_kernel == "pallas" and self.tree.nodes > 32:
+                raise ValueError(
+                    f"tree has {self.tree.nodes} nodes but the Pallas tree "
+                    f"kernel packs ancestor masks into uint32 rows (<= 32 "
+                    f"nodes); shrink tree_width/tree_depth or use "
+                    f"decode_kernel='xla'"
+                )
+        # widest device pass this engine can run in one cycle: a tree verify
+        # writes all S node positions at the lane frontier (committing at
+        # most depth + 1), a linear verify writes speculate_k + 1
+        self._spec_span = (
+            self.tree.nodes if self.tree is not None else self.speculate_k + 1
+        )
+        self._spec_any = self.tree is not None or self.speculate_k > 0
         if self.paged:
             self.page_size = int(
                 page_size if page_size is not None
@@ -580,8 +665,44 @@ class ServingEngine:
             make_lane_install(shardings=self._shardings),
             name="serve/lane_install", budget=1, registry=self.metrics,
         )
-        self._verify = (
-            RecompileWatchdog(
+        if self.tree is not None:
+            # tree mode REPLACES the linear verify window: the compiled
+            # budget grows by exactly {draft_forward, tree_verify_window}
+            self._verify = RecompileWatchdog(
+                make_paged_tree_verify_window(
+                    kmodel, self.tree, direct=True, shardings=self._shardings,
+                ) if (self.paged and self._direct)
+                else make_paged_tree_verify_window(model, self.tree,
+                                                   shardings=self._shardings)
+                if self.paged
+                else make_tree_verify_window(model, self.tree,
+                                             shardings=self._shardings),
+                name="serve/tree_verify_window", budget=1,
+                registry=self.metrics,
+            )
+            draft_cfg, draft_host = build_draft(
+                cfg, self.params, draft_model,
+                draft_ctx=self.draft_ctx, depth=self.tree_depth,
+            )
+            # the draft head is small: replicate it rather than shard — tp
+            # collectives would serialize its many tiny dispatches
+            self._draft_params = (
+                jax.device_put(draft_host) if self._shardings is None
+                else jax.device_put(draft_host, self._shardings.replicated)
+            )
+            self._draft_cfg = draft_cfg
+            self._draft_fwd = RecompileWatchdog(
+                make_draft_forward(Transformer(draft_cfg), self.tree,
+                                   self.draft_ctx, shardings=self._shardings),
+                name="serve/draft_forward", budget=1, registry=self.metrics,
+            )
+            self._draft_window = DraftContextWindow(
+                self.num_slots, self.draft_ctx, pad=self.pad_token_id
+            )
+            self._ngram = None
+            self.drafter = TreeDrafter(self.tree, draft_cfg, self._draft_fwd)
+        elif self.speculate_k:
+            self._verify = RecompileWatchdog(
                 make_paged_verify_window(
                     kmodel, self.speculate_k, direct=True,
                     shardings=self._shardings,
@@ -593,9 +714,16 @@ class ServingEngine:
                                         shardings=self._shardings),
                 name="serve/verify_window", budget=1, registry=self.metrics,
             )
-            if self.speculate_k
-            else None
-        )
+            self._draft_fwd = None
+            self._draft_window = None
+            self._ngram = NgramDrafter(max_ngram=self.speculate_ngram)
+            self.drafter = self._ngram
+        else:
+            self._verify = None
+            self._draft_fwd = None
+            self._draft_window = None
+            self._ngram = None
+            self.drafter = None
         self._copy_page = (
             RecompileWatchdog(
                 make_copy_page(shardings=self._shardings),
@@ -808,6 +936,25 @@ class ServingEngine:
             help="accepted / proposed draft tokens (cumulative) under "
                  "speculative decoding",
         )
+        self._accept_len_hist = self.metrics.histogram(
+            "serve/spec_accept_len",
+            buckets=tuple(float(i) for i in range(33)),
+            help="accepted draft tokens per drafted lane per verify cycle "
+                 "(0..K linear, 0..tree_depth along the winning tree path); "
+                 "the distribution the acceptance-vs-speedup curve samples",
+        )
+        self._draft_ms_hist = self.metrics.histogram(
+            "serve/draft_ms",
+            buckets=tuple(1e-2 * 2.0**i for i in range(20)),
+            help="host wall time per cycle to assemble + dispatch the draft "
+                 "forward (tree speculation only; device time hides under "
+                 "the verify dispatch that follows)",
+        )
+        self._tree_nodes_counter = self.metrics.counter(
+            "serve/spec_tree_nodes",
+            help="token-tree nodes verified (occupied lanes x tree nodes, "
+                 "cumulative) — the tree verify window's work volume",
+        )
         self.metrics.gauge(
             "serve/decode_kernel",
             help="info gauge: decode attention program — 1 = pallas "
@@ -970,13 +1117,14 @@ class ServingEngine:
                 retriable=False,
             )
         # headroom for the widest device pass this engine can run: a verify
-        # cycle writes speculate_k + 1 KV positions in one forward
-        span = max(self.window, self.speculate_k + 1)
+        # cycle writes speculate_k + 1 KV positions in one forward, a tree
+        # verify all tree.nodes node positions at the lane frontier
+        span = max(self.window, self._spec_span)
         need = prompt.size + gen.max_new_tokens + span
         if need > self.max_len:
             raise AdmissionError(
                 f"prompt {prompt.size} + max_new_tokens {gen.max_new_tokens} + "
-                f"max(decode_window, speculate_k + 1) {span} = {need} exceeds "
+                f"max(decode_window, speculation span) {span} = {need} exceeds "
                 f"slot capacity {self.max_len}",
                 queue_depth=self.scheduler.queue_depth,
                 retriable=False,
@@ -1146,6 +1294,18 @@ class ServingEngine:
             )
         else:
             self.params = jax.device_put(params)
+        if self.tree is not None and isinstance(self._draft_spec, int):
+            # self-speculative draft: re-slice the head from the NEW weights
+            # so the draft keeps tracking the served model across the swap
+            # (a stale head would only cost acceptance, but why pay it)
+            _, draft_host = build_draft(
+                self.config, self.params, self._draft_spec,
+                draft_ctx=self.draft_ctx, depth=self.tree_depth,
+            )
+            self._draft_params = (
+                jax.device_put(draft_host) if self._shardings is None
+                else jax.device_put(draft_host, self._shardings.replicated)
+            )
         old = self.weights_version
         if version is not None:
             self.weights_version = str(version)
@@ -1236,7 +1396,7 @@ class ServingEngine:
                 f"{self.max_prompt_len}",
                 queue_depth=self.scheduler.queue_depth, retriable=False,
             )
-        span = max(self.window, self.speculate_k + 1)
+        span = max(self.window, self._spec_span)
         remaining = max(request.config.max_new_tokens - len(request.tokens), 1)
         if eff + remaining + span > self.max_len:
             raise AdmissionError(
@@ -1939,6 +2099,11 @@ class ServingEngine:
                 self._put(rng),
             )
         self._pending_tok[s] = ptoks[-1]
+        if self._draft_window is not None:
+            # seed the draft context from the prompt tail: its last token IS
+            # the lane's pending token, which the draft forward echoes as the
+            # tree root — the invariant the tree verify's tokens[:, 0] needs
+            self._draft_window.begin(s, ptoks)
         self._active[s] = True
         self._eos[s] = eos_v
         self._do_sample[s] = gen.do_sample
@@ -2011,6 +2176,10 @@ class ServingEngine:
                 freed = self.kv.lane_release(slot)
         self._active[slot] = False
         self._slot_req[slot] = None
+        if self._ngram is not None:
+            self._ngram.retire(slot)
+        if self._draft_window is not None:
+            self._draft_window.retire(slot)
         if self.paged:
             self._lane_len[slot] = 0
         return freed
@@ -2065,7 +2234,7 @@ class ServingEngine:
             req = self._slot_req[s]
             if req is None or not hd.lane_live(s) or hd.reqs[s] is not req:
                 continue
-            if self._eos[s] >= 0 or (self.speculate_k and req.speculate):
+            if self._eos[s] >= 0 or (self._spec_any and req.speculate):
                 continue
             if len(req.tokens) + hd.width >= req.config.max_new_tokens:
                 hd.prefreed.add(s)
@@ -2091,7 +2260,7 @@ class ServingEngine:
         charged by this cycle's window (0 when idle) — ``_admit`` subtracts
         it from the scheduler's joint per-cycle budget."""
         self._cycle_decode_tokens = 0
-        if self.speculate_k and self._inflight is not None:
+        if self._spec_any and self._inflight is not None:
             self._drain_inflight()
         if not self._active.any():
             self._drain_inflight()
@@ -2100,7 +2269,7 @@ class ServingEngine:
             # map pages for the widest pass this cycle could run (the same
             # span the admission check reserved headroom for); this may
             # preempt the youngest lane under pressure, so re-check occupancy
-            self._ensure_decode_capacity(max(self.window, self.speculate_k + 1))
+            self._ensure_decode_capacity(max(self.window, self._spec_span))
             if not self._active.any():
                 self._drain_inflight()
                 return None
@@ -2112,11 +2281,18 @@ class ServingEngine:
                 f"injected decode-window dispatch failure "
                 f"(step {self._step_count}, {n_occupied} lanes)"
             )
-        drafts = self._propose_drafts() if self.speculate_k else None
-        if drafts is not None:
-            hd = self._verify_cycle(*drafts, n_occupied=n_occupied)
+        if self.tree is not None:
+            drafted = self._tree_lanes()
+            hd = (
+                self._tree_cycle(drafted, n_occupied) if drafted.any()
+                else self._decode_cycle(n_occupied)
+            )
         else:
-            hd = self._decode_cycle(n_occupied)
+            drafts = self._propose_drafts() if self.speculate_k else None
+            if drafts is not None:
+                hd = self._verify_cycle(*drafts, n_occupied=n_occupied)
+            else:
+                hd = self._decode_cycle(n_occupied)
         self._cycle_decode_tokens = n_occupied * hd.width
         if self.async_depth == 0:
             return hd
@@ -2269,6 +2445,10 @@ class ServingEngine:
                         self._lane_len[s] += int(counts[s])
             accepted = int(np.maximum(counts[hd.drafted] - 1, 0).sum())
             self._bump("spec_accepted", accepted)
+            for s in np.nonzero(hd.drafted)[0]:
+                self._accept_len_hist.observe(
+                    float(max(int(counts[s]) - 1, 0))
+                )
             if self.stats["spec_drafted"]:
                 self._accept_rate_gauge.set(
                     self.stats["spec_accepted"] / self.stats["spec_drafted"]
@@ -2385,7 +2565,14 @@ class ServingEngine:
         [N])`` or ``None`` when no active opted-in lane found a match (the
         cycle falls back to the plain decode window).  Lanes without a match
         carry pad drafts — verification rejects them, and the lane still
-        lands its >= 1 guaranteed token from the verify forward."""
+        lands its >= 1 guaranteed token from the verify forward.
+
+        Drafting goes through the per-lane incremental suffix index
+        (:class:`~accelerate_tpu.serving.spec.NgramIndex` via
+        :class:`~accelerate_tpu.serving.spec_exec.NgramDrafter`): each call
+        feeds the index only the tokens committed since the previous cycle,
+        so the host cost is O(K) per lane regardless of context length —
+        token-identical to the O(context) rescan it replaced."""
         k = self.speculate_k
         drafts = np.full((self.num_slots, k), self.pad_token_id, np.int32)
         drafted = np.zeros(self.num_slots, bool)
@@ -2393,16 +2580,126 @@ class ServingEngine:
             req = self._slot_req[s]
             if req is None or not req.speculate:
                 continue
-            d = propose_ngram_draft(
-                req.output_ids, k,
-                max_ngram=self.speculate_ngram, pad=self.pad_token_id,
-            )
+            d = self._ngram.propose(int(s), req.output_ids, k)
             if d is not None:
                 drafts[s] = d
                 drafted[s] = True
         if not drafted.any():
             return None
         return drafts, drafted
+
+    def _tree_lanes(self) -> np.ndarray:
+        """Active lanes opted into speculation this cycle (tree mode).  The
+        draft model drafts for every lane in the batch anyway; this mask only
+        scopes the accounting (``spec_drafted``/accept stats) and the
+        all-opted-out fallback to the plain decode window."""
+        drafted = np.zeros(self.num_slots, bool)
+        for s in np.nonzero(self._active)[0]:
+            req = self._slot_req[s]
+            if req is not None and req.speculate:
+                drafted[s] = True
+        return drafted
+
+    def _tree_cycle(self, drafted: np.ndarray, n_occupied: int) -> Readback:
+        """Dispatch one draft forward + tree verify window pair; returns the
+        verify handle.  The draft's ``[N, S]`` token tree never touches the
+        host — the draft forward's output handle feeds the verify window
+        directly, so the host cost of a tree cycle is two dispatches plus
+        the usual control-state uploads.
+
+        The draft context window's tail token equals each active lane's
+        pending token (seeded at install, advanced in ``_emit``), so the
+        draft output's column 0 — the tree root — is exactly the pending
+        token the verify forward must score first.  Inactive lanes carry
+        garbage roots; their writes are masked (paged: NULL_PAGE-routed)
+        and their commits never emit."""
+        tree = self.tree
+        lanes = self._lane_arrays()
+        self._note_dispatch()
+        t0 = time.perf_counter()
+        dw = self._draft_window
+        ctx = self._put(dw.tokens)
+        length = self._put(dw.length)
+        if not self.cost_table.captured("serve/draft_forward"):
+            self.cost_table.capture(
+                "serve/draft_forward", self._draft_fwd,
+                (self._draft_params, ctx, length),
+            )
+        with self.tracer.span("serve/draft_forward", occupied=n_occupied):
+            tokens = self.drafter.propose_device(self._draft_params, ctx, length)
+        self._draft_ms_hist.observe((time.perf_counter() - t0) * 1e3)
+        n_drafted = int(drafted.sum())
+        qerr = None
+        if self.paged and self._direct:
+            kv = self.kv
+            audit_donation(kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales)
+            consumed = [kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+                        lanes[0], lanes[-1]]
+            tables = self._put(kv.tables)
+            index = self._put(self._lane_len)
+            consumed += [tables, index, tokens]
+            args = (self.params, kv.pages_k, kv.pages_v, kv.k_scales,
+                    kv.v_scales, tables, index, tokens, *lanes[1:])
+            if not self.cost_table.captured("serve/tree_verify_window"):
+                self.cost_table.capture(
+                    "serve/tree_verify_window", self._verify, args
+                )
+            with self.tracer.span("serve/tree_verify_window",
+                                  occupied=n_occupied, drafted=n_drafted):
+                with self.tracer.span("serve/paged_attn",
+                                      kernel=self.decode_kernel):
+                    (kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, out,
+                     n_commit, pending, rngs, qerr) = self._verify(*args)
+        elif self.paged:
+            kv = self.kv
+            audit_donation(kv.pages_k, kv.pages_v)
+            consumed = [kv.pages_k, kv.pages_v, lanes[0], lanes[-1]]
+            tables = self._put(kv.tables)
+            index = self._put(self._lane_len)
+            consumed += [tables, index, tokens]
+            if not self.cost_table.captured("serve/tree_verify_window"):
+                self.cost_table.capture(
+                    "serve/tree_verify_window", self._verify,
+                    (self.params, kv.pages_k, kv.pages_v, tables, index,
+                     tokens, *lanes[1:]),
+                )
+            with self.tracer.span("serve/tree_verify_window",
+                                  occupied=n_occupied, drafted=n_drafted):
+                kv.pages_k, kv.pages_v, out, n_commit, pending, rngs = (
+                    self._verify(
+                        self.params, kv.pages_k, kv.pages_v, tables, index,
+                        tokens, *lanes[1:]
+                    )
+                )
+        else:
+            audit_donation(self.pool)
+            consumed = [self.pool, lanes[0], lanes[-1], tokens]
+            if not self.cost_table.captured("serve/tree_verify_window"):
+                self.cost_table.capture(
+                    "serve/tree_verify_window", self._verify,
+                    (self.params, self.pool, tokens, *lanes[1:]),
+                )
+            with self.tracer.span("serve/tree_verify_window",
+                                  occupied=n_occupied, drafted=n_drafted):
+                self.pool, out, n_commit, pending, rngs = self._verify(
+                    self.params, self.pool, tokens, *lanes[1:]
+                )
+        lanes[0], lanes[-1] = pending, rngs
+        self._bump("decode_steps", tree.depth + 1)
+        self._bump("occupied_lane_steps", n_occupied * (tree.depth + 1))
+        # accounting uses depth (the max acceptable along one path), not
+        # tree nodes: accept rate stays in [0, 1] and comparable across
+        # linear and tree arms; node volume has its own counter
+        self._bump("spec_drafted", n_drafted * tree.depth)
+        self._tree_nodes_counter.inc(n_occupied * tree.nodes)
+        consumed += self._stale_handles
+        self._stale_handles = []
+        return Readback(
+            kind="verify", toks=out, width=tree.depth + 1, counts=n_commit,
+            qerr=qerr, active=self._active.copy(), reqs=list(self._slot_req),
+            eos=self._eos.copy(), n_occupied=n_occupied,
+            drafted=drafted.copy(), n_drafted=n_drafted, consumed=consumed,
+        )
 
     def _verify_cycle(self, drafts: np.ndarray, drafted: np.ndarray,
                       n_occupied: int) -> Readback:
@@ -2548,6 +2845,10 @@ class ServingEngine:
                     hist.observe(now - req.submit_time)
             for t in toks[s, :n]:
                 req.emit(int(t))
+            if owner and self._draft_window is not None:
+                # keep the draft context's tail == the lane's pending token
+                # (the committed suffix ends with the next pending token)
+                self._draft_window.push(int(s), toks[s, :n])
             self._bump("tokens_generated", n)
             # a cycle lands n tokens on this lane at once: each is charged its
             # amortized share of the wall time since the lane's last arrival
@@ -2793,7 +3094,8 @@ class ServingEngine:
         workload each entry is at most 1 (copy entries exist only while the
         prefix cache is enabled and stay 0 until the first hit; the
         verify_window entry exists only when ``speculate_k > 0`` and stays 0
-        until the first drafted cycle).  Paged mode swaps insert and the
+        until the first drafted cycle; tree speculation swaps it for exactly
+        two entries, ``tree_verify_window`` and ``draft_forward``).  Paged mode swaps insert and the
         per-bucket copies for a single ``copy_page`` (0 until the first
         copy-on-write); cache hits alias pages, so the hit path adds no
         executable at all.  ``lane_install`` is the one-slot lane-vector
@@ -2810,7 +3112,10 @@ class ServingEngine:
         else:
             out["insert"] = jit_cache_sizes(self._insert)
         if self._verify is not None:
-            out["verify_window"] = jit_cache_sizes(self._verify)
+            out["tree_verify_window" if self.tree is not None
+                else "verify_window"] = jit_cache_sizes(self._verify)
+        if self._draft_fwd is not None:
+            out["draft_forward"] = jit_cache_sizes(self._draft_fwd)
         for b, f in self._prefill.items():
             out[f"prefill_{b}"] = jit_cache_sizes(f)
         for b, f in self._copy.items():

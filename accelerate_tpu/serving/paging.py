@@ -322,4 +322,57 @@ class PagedKVPool:
         )
 
 
-__all__ = ["NULL_PAGE", "PageAllocator", "PagedKVPool"]
+class DraftContextWindow:
+    """Host-side sliding context for the draft model — the one piece of
+    per-lane drafting state :func:`~accelerate_tpu.serving.spec_exec
+    .make_draft_forward` needs.
+
+    The draft forward is stateless (it re-prefills its context every cycle
+    into an in-trace scratch cache), so the host only has to hand it the
+    last ``width`` visible tokens per lane, right-padded, plus a valid
+    length.  Two numpy slabs sized ``[slots, width]`` / ``[slots]`` make
+    that a zero-copy dispatch argument: :meth:`begin` seeds a lane from its
+    prompt tail, :meth:`push` slides committed tokens in after each verify
+    drain, :meth:`retire` zeroes the row.  A bounded window (default 64 in
+    the engine) deliberately trades long-range draft context for a fixed,
+    small prefill cost — the draft's job is local continuation ranking, and
+    tokens beyond the window only reach it through the lane's real KV at
+    verify time anyway.
+    """
+
+    def __init__(self, slots: int, width: int, pad: int = 0) -> None:
+        if width < 1:
+            raise ValueError(f"need width >= 1, got {width}")
+        self.width = width
+        self.pad = pad
+        self.tokens = np.full((slots, width), pad, dtype=np.int32)
+        self.length = np.zeros(slots, dtype=np.int32)
+
+    def begin(self, slot: int, tokens: Sequence[int]) -> None:
+        """Seed ``slot`` from a prompt: keep the last ``width`` tokens."""
+        toks = np.asarray(tokens, dtype=np.int32).ravel()[-self.width:]
+        self.tokens[slot] = self.pad
+        self.tokens[slot, : toks.size] = toks
+        self.length[slot] = toks.size
+
+    def push(self, slot: int, tokens: Sequence[int]) -> None:
+        """Append committed tokens, sliding the window left on overflow."""
+        toks = np.asarray(tokens, dtype=np.int32).ravel()
+        if toks.size >= self.width:
+            self.tokens[slot] = toks[-self.width:]
+            self.length[slot] = self.width
+            return
+        n = int(self.length[slot])
+        spill = n + toks.size - self.width
+        if spill > 0:
+            self.tokens[slot, : n - spill] = self.tokens[slot, spill:n]
+            n -= spill
+        self.tokens[slot, n : n + toks.size] = toks
+        self.length[slot] = n + toks.size
+
+    def retire(self, slot: int) -> None:
+        self.tokens[slot] = self.pad
+        self.length[slot] = 0
+
+
+__all__ = ["NULL_PAGE", "DraftContextWindow", "PageAllocator", "PagedKVPool"]

@@ -40,7 +40,11 @@ Compiled-shape budget for an engine instance: ``1 (decode window) +
 len(prefill_buckets) + 1 (insert)``, plus ``len(prefill_buckets)`` copy
 executables when the prefix cache is enabled, plus ``1`` verify executable
 when ``speculate_k > 0`` — asserted by the serving tests via the jit cache
-counters.
+counters.  Model-based tree speculation (``draft_model=``) swaps the verify
+executable for exactly two: ``1`` tree verify window
+(:func:`make_tree_verify_window` — the ``[slots, tree_nodes]`` bucket is
+static per engine, never call-varying) and ``1`` draft forward
+(:func:`~accelerate_tpu.serving.spec_exec.make_draft_forward`).
 """
 
 from __future__ import annotations
@@ -329,6 +333,208 @@ def _verify_body(model: Transformer, k: int, params, cache, tokens, active, eos,
     new_pending = jnp.take_along_axis(out, last[:, None], axis=1)[:, 0]
     return cache, out, n_commit, new_pending, new_rngs
 
+
+def make_tree_verify_window(model: Transformer, tree,
+                            shardings: Optional[ServeShardings] = None):
+    """One jitted *tree* speculative verify pass: ``S = tree.nodes`` drafted
+    tree positions per lane, one forward — the generalization of
+    :func:`make_verify_window` from a linear ``[slots, K+1]`` window to a
+    token tree ``[slots, S]``.
+
+    ``(params, cache, tokens [N, S], active [N], eos [N], do_sample [N],
+    temperature [N], top_k [N], top_p [N], pad [N], rngs [N, 2])
+    -> (cache, out [N, D+1], n_commit [N], new_pending [N], new_rngs)``
+
+    ``tree`` is a :class:`~accelerate_tpu.serving.spec_exec.TreeSpec`:
+    ``tokens[:, 0]`` is each lane's pending token (tree root), node ``i``'s
+    draft token at ``tokens[:, i]`` extends its parent's branch
+    (:meth:`TreeSpec` chains topology — ``width`` sibling branches of
+    ``depth`` model-drafted tokens).  The single forward writes all ``S``
+    nodes' KV contiguously at each lane's frontier, attends under the
+    ancestor mask (``tree_mask`` through the model), and the acceptance rule
+    selects ONE root-to-leaf path to commit:
+
+    * **greedy lanes** — the branch with the longest exact prefix match
+      against the model's argmax chain wins (ties: lowest branch id); the
+      committed tokens are the argmaxes along that path, bitwise the tokens
+      sequential greedy decode would emit.
+    * **sampled lanes** — multi-try speculative sampling at the branch point
+      (each sibling candidate is tried against the running residual
+      distribution — exact for the point-mass drafts a draft model emits),
+      then the linear Leviathan accept/residual-resample down the chosen
+      branch; one bonus token at the deepest path node.  Output distribution
+      preserved exactly.
+
+    After acceptance the winning path's KV rows are *compacted* to the lane
+    frontier (losing branches' rows are overwritten or left dead past the
+    rolled-back index) and the index advances by ``n_commit`` — so the cache
+    layout a subsequent window sees is byte-for-byte what linear decode would
+    have produced.
+    """
+    def tree_verify_window(params, cache, tokens, active, eos, do_sample,
+                           temperature, top_k, top_p, pad, rngs):
+        return _tree_verify_body(model, tree, params, cache, tokens, active,
+                                 eos, do_sample, temperature, top_k, top_p,
+                                 pad, rngs)
+
+    s = shardings
+    return _serve_jit(
+        tree_verify_window,
+        donate_argnums=(1,),
+        in_shardings=None if s is None else (s.params, s.cache(), *s.rep(9)),
+        out_shardings=None if s is None else (s.cache(), *s.rep(4)),
+    )
+
+
+def _tree_verify_body(model: Transformer, tree, params, cache, tokens, active,
+                      eos, do_sample, temperature, top_k, top_p, pad, rngs):
+    """Forward + branch-select/commit of one tree verify pass — shared by the
+    slab, gathered-paged and direct-paged tree windows (one traced accept
+    program, no numeric drift between pool layouts)."""
+    from ..models.generation import filter_logits_batched
+
+    w, depth = tree.width, tree.depth
+    s_nodes = tree.nodes
+    dp1 = depth + 1
+    n = tokens.shape[0]
+    prev_index = cache.index
+    paths_j = jnp.asarray(tree.paths, jnp.int32)         # [W, D+1]
+    # node i sits at sequence position frontier + depth(i); positions must be
+    # explicit — consecutive-slot defaults would misplace sibling branches
+    positions = prev_index[:, None] + jnp.asarray(tree.depth_arr, jnp.int32)[None, :]
+    logits, cache = model.apply(
+        {"params": params}, tokens, positions=positions, cache=cache,
+        tree_mask=tree.anc,
+    )
+    logits = logits.astype(jnp.float32)                  # [N, S, V]
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # ok[i]: node i's draft token equals the model's argmax at its parent —
+    # the tree analog of ``greedy[:, :k] == drafts``
+    ok = tokens == jnp.take(greedy, jnp.asarray(tree.parent, jnp.int32), axis=1)
+    chain = jnp.asarray(tree.paths[:, 1:].reshape(-1), jnp.int32)   # [W*D]
+    ok_chain = ok[:, chain].reshape(n, w, depth)
+    acc_len = jnp.cumprod(ok_chain.astype(jnp.int32), axis=2).sum(axis=2)
+    best_greedy = jnp.argmax(acc_len, axis=1).astype(jnp.int32)     # [N]
+    use_sample = do_sample & (temperature > 0.0)
+    split = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+    draw_rngs, new_rngs = split[:, 0], split[:, 1]
+
+    def _path_emit(best):
+        path = jnp.take(paths_j, best, axis=0)                      # [N, D+1]
+        emit = jnp.take_along_axis(greedy, path, axis=1)            # [N, D+1]
+        acc = jnp.take_along_axis(ok, path[:, 1:], axis=1)          # [N, D]
+        return path, emit, acc
+
+    def _greedy(_):
+        _, emit, acc = _path_emit(best_greedy)
+        return emit, acc, best_greedy
+
+    def _sampled(_):
+        rep = lambda x: jnp.repeat(x, s_nodes, axis=0)
+        filt = filter_logits_batched(
+            logits.reshape(n * s_nodes, vocab),
+            temperature=rep(temperature), top_k=rep(top_k), top_p=rep(top_p),
+        ).reshape(n, s_nodes, vocab)
+        neg_inf = jnp.finfo(jnp.float32).min
+        # per lane: W branch tries + 1 branch fallback + (D-1) * (accept draw
+        # + residual resample) + 1 bonus draw = W + 2D keys
+        keys = jax.vmap(lambda r: jax.random.split(r, w + 2 * depth))(draw_rngs)
+
+        # --- branch point: multi-try speculative sampling over the W sibling
+        # candidates.  Trying candidate b against the running residual (all
+        # previously tried tokens masked out) and falling through to a final
+        # residual sample reproduces the root distribution exactly — the
+        # multi-candidate generalization of the Leviathan point-mass rule.
+        rem = filt[:, 0]                                 # [N, V]
+        acc1 = jnp.zeros(n, bool)
+        pick = jnp.zeros(n, jnp.int32)
+        tok1 = jnp.zeros(n, jnp.int32)
+        for b in range(w):
+            d_b = tokens[:, int(tree.paths[b, 1])]
+            p_b = jnp.take_along_axis(
+                jax.nn.softmax(rem, axis=-1), d_b[:, None], axis=1
+            )[:, 0]
+            u_b = jax.vmap(jax.random.uniform)(keys[:, b])
+            take = (~acc1) & (u_b < p_b)
+            pick = jnp.where(take, b, pick)
+            tok1 = jnp.where(take, d_b, tok1)
+            acc1 = acc1 | take
+            rem = jnp.where(jax.nn.one_hot(d_b, vocab, dtype=bool), neg_inf, rem)
+        res1 = jax.vmap(jax.random.categorical)(keys[:, w], rem).astype(jnp.int32)
+        tok1 = jnp.where(acc1, tok1, res1)
+        path_s = jnp.take(paths_j, pick, axis=0)         # [N, D+1]
+
+        # --- down the chosen branch: the linear point-mass accept/resample
+        emit_cols = [tok1]
+        acc_cols = [acc1]
+        for t in range(1, depth):
+            node_t = path_s[:, t]
+            filt_t = jnp.take_along_axis(
+                filt, node_t[:, None, None], axis=1
+            )[:, 0]                                      # [N, V]
+            d_t = jnp.take_along_axis(
+                tokens, path_s[:, t + 1][:, None], axis=1
+            )[:, 0]
+            p_t = jnp.take_along_axis(
+                jax.nn.softmax(filt_t, axis=-1), d_t[:, None], axis=1
+            )[:, 0]
+            u_t = jax.vmap(jax.random.uniform)(keys[:, w + 2 * t - 1])
+            acc_t = u_t < p_t
+            resid = jnp.where(jax.nn.one_hot(d_t, vocab, dtype=bool), neg_inf, filt_t)
+            res_t = jax.vmap(jax.random.categorical)(
+                keys[:, w + 2 * t], resid
+            ).astype(jnp.int32)
+            emit_cols.append(jnp.where(acc_t, d_t, res_t))
+            acc_cols.append(acc_t)
+        filt_deep = jnp.take_along_axis(
+            filt, path_s[:, depth][:, None, None], axis=1
+        )[:, 0]
+        bonus = jax.vmap(jax.random.categorical)(
+            keys[:, w + 2 * depth - 1], filt_deep
+        ).astype(jnp.int32)
+        emit_cols.append(bonus)
+        emit_s = jnp.stack(emit_cols, axis=1)            # [N, D+1]
+        acc_s = jnp.stack(acc_cols, axis=1)              # [N, D]
+
+        _, emit_g, acc_g = _path_emit(best_greedy)
+        emit = jnp.where(use_sample[:, None], emit_s, emit_g)
+        acc = jnp.where(use_sample[:, None], acc_s, acc_g)
+        best = jnp.where(use_sample, pick, best_greedy)
+        return emit, acc, best
+
+    emit, acc, best = jax.lax.cond(jnp.any(use_sample), _sampled, _greedy, None)
+    path = jnp.take(paths_j, best, axis=0)               # [N, D+1]
+    n_accept = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+    pos = jnp.arange(dp1)[None, :]
+    committable = pos <= n_accept[:, None]
+    is_eos = (emit == eos[:, None]) & (eos >= 0)[:, None]
+    eos_before = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos) > 0
+    commit = committable & ~eos_before & active[:, None]
+    n_commit = commit.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(commit, emit, pad[:, None])
+    # commit the winning path's KV to the lane frontier, roll back the rest:
+    # the layout any later window sees is what linear decode would have built
+    if isinstance(cache, PagedKVCache):
+        cache = _tree_commit_paged(cache, prev_index, path)
+        cache = cache.replace(index=prev_index + n_commit)
+    else:
+        def _compact(kv):
+            def lane(kv_lane, idx, p):
+                rows = jnp.take(kv_lane, idx + p, axis=1)    # [L, D+1, H, Dh]
+                return jax.lax.dynamic_update_slice(kv_lane, rows, (0, idx, 0, 0))
+
+            return jax.vmap(lane, in_axes=(1, 0, 0), out_axes=1)(
+                kv, prev_index, path
+            )
+
+        cache = cache.replace(
+            k=_compact(cache.k), v=_compact(cache.v),
+            index=prev_index + n_commit,
+        )
+    last = jnp.maximum(n_commit - 1, 0)
+    new_pending = jnp.take_along_axis(out, last[:, None], axis=1)[:, 0]
+    return cache, out, n_commit, new_pending, new_rngs
 
 
 def make_prefill_chunk(model: Transformer, chunk_len: int,
@@ -744,6 +950,138 @@ def make_paged_verify_window(model: Transformer, k: int, direct: bool = False,
 
     return _serve_jit(
         paged_verify_window,
+        donate_argnums=(1, 2),
+        in_shardings=None if s is None else (s.params, s.kv, s.kv, *s.rep(11)),
+        out_shardings=None if s is None else (s.kv, s.kv, *s.rep(4)),
+    )
+
+
+def _tree_commit_paged(cache: PagedKVCache, prev_index, path):
+    """Commit a tree verify's winning path inside the page pool: gather the
+    ``D+1`` path nodes' KV rows through each lane's block table and re-insert
+    them contiguously at the lane frontier — the paged twin of the slab
+    compaction in :func:`_tree_verify_body`.  Quantized pools dequantize the
+    gathered rows and requantize at insert (the same scatter-time scale
+    discipline as every other paged write; the round-trip error folds into
+    ``quant_err``).  Losing branches' rows past ``frontier + D`` are zeroed by
+    the next insert touching their page (stale-slot rule of
+    :func:`~accelerate_tpu.ops.paged_attention.paged_quantized_insert`) and
+    are never visible to attention (masked past each lane's length)."""
+    from ..ops.paged_attention import (
+        kv_qmax,
+        paged_insert,
+        paged_quantized_insert,
+    )
+
+    page = cache.pages_k.shape[2]
+    p_max = cache.tables.shape[1] - 1
+    pos = prev_index[:, None] + path                     # [N, D+1]
+    pid = jnp.take_along_axis(
+        cache.tables, jnp.clip(pos // page, 0, p_max), axis=1
+    )
+    off = pos % page
+    quantized = kv_qmax(cache.pages_k.dtype) is not None
+
+    def _rows(pages, scales):
+        rows = pages[:, pid, off]                        # [L, N, D+1, H, Dh]
+        if quantized:
+            rows = rows.astype(jnp.float32) * scales[:, pid][..., None]
+        return rows
+
+    rows_k = _rows(cache.pages_k, cache.k_scales)
+    rows_v = _rows(cache.pages_v, cache.v_scales)
+    if quantized:
+        ins = jax.vmap(
+            lambda p, sc, r: paged_quantized_insert(
+                p, sc, r, cache.tables, prev_index, cache.active
+            )
+        )
+        pages_k, k_scales, err_k = ins(cache.pages_k, cache.k_scales, rows_k)
+        pages_v, v_scales, err_v = ins(cache.pages_v, cache.v_scales, rows_v)
+        err = jnp.maximum(jnp.max(err_k), jnp.max(err_v))
+        return cache.replace(
+            pages_k=pages_k, pages_v=pages_v,
+            k_scales=k_scales, v_scales=v_scales,
+            quant_err=jnp.maximum(cache.quant_err, err),
+        )
+    ins = jax.vmap(
+        lambda p, r: paged_insert(p, r, cache.tables, prev_index, cache.active)
+    )
+    return cache.replace(
+        pages_k=ins(cache.pages_k, rows_k), pages_v=ins(cache.pages_v, rows_v)
+    )
+
+
+def make_paged_tree_verify_window(model: Transformer, tree,
+                                  direct: bool = False,
+                                  shardings: Optional[ServeShardings] = None):
+    """Paged tree speculative verify — :func:`make_tree_verify_window` over
+    the page pool.  ``(params, pages_k, pages_v, tables, index,
+    tokens [N, S], ...) -> (pages_k, pages_v, out [N, D+1], n_commit,
+    new_pending, new_rngs)``.
+
+    ``direct=False`` runs the slab :func:`_tree_verify_body` (including its
+    slab compaction) over a gathered per-lane view and scatters all ``S``
+    written positions back — rows past the compacted frontier are unreachable
+    garbage, exactly like rejected positions in the linear paged verify.
+    ``direct=True`` threads the :class:`PagedKVCache` through the model (the
+    quantized / pallas-kernel path); the winning path commits via
+    :func:`_tree_commit_paged` and the signature gains the scale arrays and a
+    trailing ``quant_err``.
+    """
+    s_nodes = tree.nodes
+    s = shardings
+
+    if direct:
+        def direct_tree_verify_window(params, pages_k, pages_v, k_scales,
+                                      v_scales, tables, index, tokens, active,
+                                      eos, do_sample, temperature, top_k,
+                                      top_p, pad, rngs):
+            cache = PagedKVCache(
+                pages_k=pages_k, pages_v=pages_v,
+                k_scales=k_scales, v_scales=v_scales,
+                tables=tables, index=index, active=active,
+                quant_err=jnp.float32(0.0),
+            )
+            cache, out, n_commit, new_pending, new_rngs = _tree_verify_body(
+                model, tree, params, cache, tokens, active, eos, do_sample,
+                temperature, top_k, top_p, pad, rngs,
+            )
+            return (cache.pages_k, cache.pages_v, cache.k_scales,
+                    cache.v_scales, out, n_commit, new_pending, new_rngs,
+                    cache.quant_err)
+
+        return _serve_jit(
+            direct_tree_verify_window,
+            donate_argnums=(1, 2, 3, 4),
+            in_shardings=None if s is None else (
+                s.params, s.kv, s.kv, s.scales, s.scales, *s.rep(11),
+            ),
+            out_shardings=None if s is None else (
+                s.kv, s.kv, s.scales, s.scales, *s.rep(5),
+            ),
+        )
+
+    def paged_tree_verify_window(params, pages_k, pages_v, tables, index,
+                                 tokens, active, eos, do_sample, temperature,
+                                 top_k, top_p, pad, rngs):
+        page = pages_k.shape[2]
+        gt = _live_tables(tables, (index + s_nodes - 1) // page + 1)
+        cache = KVCache(
+            k=_gather_view(pages_k, gt),
+            v=_gather_view(pages_v, gt),
+            index=index,
+        )
+        cache, out, n_commit, new_pending, new_rngs = _tree_verify_body(
+            model, tree, params, cache, tokens, active, eos, do_sample,
+            temperature, top_k, top_p, pad, rngs,
+        )
+        pages_k = _scatter_span(pages_k, cache.k, tables, index, s_nodes, active)
+        pages_v = _scatter_span(pages_v, cache.v, tables, index, s_nodes, active)
+        return pages_k, pages_v, out, n_commit, new_pending, new_rngs
+
+    return _serve_jit(
+        paged_tree_verify_window,
         donate_argnums=(1, 2),
         in_shardings=None if s is None else (s.params, s.kv, s.kv, *s.rep(11)),
         out_shardings=None if s is None else (s.kv, s.kv, *s.rep(4)),

@@ -35,7 +35,7 @@ a drain and the next dispatch.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -85,3 +85,69 @@ def propose_ngram_draft(
             lag = n_ctx - start                # local period implied by the match
             return context[start + (np.arange(k) % lag)]
     return None
+
+
+class NgramIndex:
+    """Incremental per-lane suffix index: :func:`propose_ngram_draft` without
+    the per-cycle O(context) rescan.
+
+    The brute-force matcher re-walks the whole context every verify cycle to
+    find the most recent earlier occurrence of the trailing n-gram.  This
+    index instead keeps, for every n-gram size, a dict mapping each window
+    (as a token tuple) to the *latest* start position where it occurs —
+    maintained by :meth:`append` in O(max_ngram) per committed token, so
+    steady-state drafting is O(k) per cycle regardless of context length.
+
+    Equivalence with the rescan: the brute force takes ``hits[-1]`` (the
+    largest matching start over windows of ``context[:n_ctx - 1]``), and the
+    dict records each start exactly once in increasing order, so its value
+    IS the largest start seen.  :meth:`append` records the window *ending
+    just before* the new token, which keeps the trailing n-gram itself out of
+    the index until a later token makes it an "earlier" occurrence — the
+    same strict-before-the-tail rule the sliding-window scan enforces.
+    Token-identical by construction; ``TestNgramDraft`` pins both paths to
+    the same goldens.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1) -> None:
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got [{min_ngram}, {max_ngram}]"
+            )
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self._ctx: list = []
+        self._idx: Dict[int, Dict[Tuple[int, ...], int]] = {
+            n: {} for n in range(min_ngram, max_ngram + 1)
+        }
+
+    def __len__(self) -> int:
+        return len(self._ctx)
+
+    def append(self, token: int) -> None:
+        """Commit one token: index every window that *ends* at the old tail
+        (the new token is its follower), then grow the context."""
+        ctx, L = self._ctx, len(self._ctx)
+        for n in range(self.min_ngram, min(self.max_ngram, L) + 1):
+            self._idx[n][tuple(ctx[L - n:])] = L - n
+        ctx.append(int(token))
+
+    def extend(self, tokens) -> None:
+        for t in np.asarray(tokens, dtype=np.int32).ravel():
+            self.append(int(t))
+
+    def propose(self, k: int) -> Optional[np.ndarray]:
+        """O(k) draft: longest trailing n-gram whose latest earlier start is
+        on record, extended cyclically exactly like the rescan path."""
+        ctx, n_ctx = self._ctx, len(self._ctx)
+        if k <= 0 or n_ctx < self.min_ngram + 1:
+            return None
+        for n in range(min(self.max_ngram, n_ctx - 1), self.min_ngram - 1, -1):
+            s = self._idx[n].get(tuple(ctx[n_ctx - n:]))
+            if s is not None:
+                start = s + n
+                lag = n_ctx - start
+                return np.asarray(
+                    [ctx[start + (j % lag)] for j in range(k)], dtype=np.int32
+                )
+        return None

@@ -1,0 +1,34 @@
+"""jit-signature-drift (tree verify window): the tree window fed
+call-varying shapes — three violations (the token tree sliced down to the
+cycle's drafted-lane count, a draft-context pad constructor sized by it, and
+the drifting count itself passed positionally as the lanes argument).  The
+final call is the engine's actual idiom — the full ``[slots, nodes]`` tree
+dispatched every cycle with inactive lanes masked — and must stay
+unflagged: the tree shape is engine-static, never call-varying."""
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self, tree):
+        self._verify = {
+            tree.nodes: _serve_jit(  # noqa: F821 — fixture stub
+                make_paged_tree_verify_window(tree),  # noqa: F821
+            ),
+        }
+
+    def tree_cycle(self, drafted, tokens, kv, lanes):
+        n = len(drafted)
+        bad_slice = self._verify[7](
+            self.params, kv.pages_k, kv.pages_v, kv.tables,
+            tokens[:n], lanes)
+        bad_pad = self._verify[7](
+            self.params, kv.pages_k, kv.pages_v, kv.tables,
+            jnp.zeros(n, jnp.int32), lanes)
+        bad_lanes = self._verify[7](
+            self.params, kv.pages_k, kv.pages_v, kv.tables,
+            tokens, n)
+        good = self._verify[7](
+            self.params, kv.pages_k, kv.pages_v, kv.tables,
+            mask_inactive(tokens, 7),  # noqa: F821 — fixture stub
+            lanes)
+        return bad_slice, bad_pad, bad_lanes, good

@@ -1,0 +1,28 @@
+"""use-after-donate (tree verify window): the tree verify donates the paged
+pool — two violations: a read of the donated ``kv.pages_k`` after dispatch
+(``tree_cycle_then_audit`` summing the old pages for an accept-rate probe),
+and the donate-and-rebind in ``tree_cycle`` dropping the old pool handles
+without parking them while the in-flight draft forward + verify pair may
+still consume them.  The draft forward itself donates nothing (its context
+slab re-uploads every cycle), so only the verify handles are at stake."""
+
+
+class Engine:
+    def __init__(self, tree):
+        self._verify = _serve_jit(  # noqa: F821 — fixture stub
+            make_paged_tree_verify_window(tree),  # noqa: F821 — fixture stub
+            donate_argnums=(1, 2),
+        )
+
+    def tree_cycle(self, tokens, lanes):
+        kv = self.kv
+        kv.pages_k, kv.pages_v, out, n_commit = self._verify(
+            self.params, kv.pages_k, kv.pages_v, kv.tables, tokens, lanes)
+        return out, n_commit
+
+    def tree_cycle_then_audit(self, tokens, lanes):
+        kv = self.kv
+        new_k, new_v, out, n_commit = self._verify(
+            self.params, kv.pages_k, kv.pages_v, kv.tables, tokens, lanes)
+        stale = kv.pages_k.sum()
+        return new_k, new_v, out, n_commit, stale

@@ -192,6 +192,33 @@ def test_jit_signature_drift_promote_install():
     assert "passed positionally" in msgs
 
 
+def test_use_after_donate_tree_verify():
+    """The tree verify window donates the paged pool: reading a donated
+    handle for a post-dispatch audit and the unparked donate-and-rebind each
+    fire — the two regressions that would re-serialize the draft+verify
+    pipelined pair."""
+    report = run_rules(["use-after-donate"],
+                       ["use_after_donate_tree_bad.py"])
+    assert len(report.diagnostics) == 2, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "'kv.pages_k' was donated" in msgs and "read here" in msgs
+    assert "donate-and-rebind" in msgs and "park the old" in msgs
+
+
+def test_jit_signature_drift_tree_verify():
+    """The tree verify window fed call-varying shapes fires three ways (token
+    tree sliced by the drafted-lane count, a pad constructor sized by it, the
+    count passed positionally); the engine's static full-width masked
+    dispatch stays unflagged."""
+    report = run_rules(["jit-signature-drift"],
+                       ["jit_signature_drift_tree_bad.py"])
+    assert len(report.diagnostics) == 3, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "sliced by a call-varying bound" in msgs
+    assert "zeros(...) sized by a call-varying" in msgs
+    assert "passed positionally" in msgs
+
+
 def test_metric_docs_both_directions():
     root = FIX / "metric_docs_proj"
     report = run_rules(["metric-docs"], ["pkg"], root=root)
