@@ -35,7 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ...logging import get_logger
 from ...models.generation import GenerationConfig
-from ...telemetry import get_flight_recorder, get_reqtrace
+from ...telemetry import get_flight_recorder, get_reqtrace, slo_tick
 from ..errors import AdmissionError, DeadlineExceeded
 from ..router import ReplicaRouter
 from ..scheduler import Request, RequestState
@@ -209,6 +209,7 @@ class FrontDoor:
             req = self.router.submit(
                 call.prompt, config=gen, on_token=on_token,
                 model_version=model_version, deadline_s=call.deadline_s,
+                tenant=call.tenant,
             )
             self._next_key += 1
             stream = TokenStream(self._next_key)
@@ -379,6 +380,11 @@ class FrontDoor:
                     outstanding=len(self._outstanding),
                 )
                 self._last_heartbeat = now
+                # fleet-health tick rides the heartbeat: samples the
+                # time-series ring and re-evaluates installed SLOs even
+                # while the server is idle (an idle replica can still be
+                # burning availability budget on sheds it just served)
+                slo_tick()
             if not worked and self._tickets.empty():
                 time.sleep(self.idle_sleep_s)
         # drain: fail any still-waiting tickets rather than strand threads

@@ -75,6 +75,11 @@ class CompletionCall:
     # queue estimate says it is unmeetable, and a running lane that blows it
     # is cancelled with a 504 (engine-side deadline sweep)
     deadline_s: Optional[float] = None
+    # caller attribution: set by the HTTP layer from the ``X-Tenant`` header
+    # (or the Authorization API-key prefix), never from the JSON body — the
+    # body is caller-controlled, the header is gateway-controlled.  Threads
+    # through FrontDoor -> ReplicaRouter -> ServingEngine.submit(tenant=)
+    tenant: Optional[str] = None
 
 
 def _require_dict(body: Any) -> Dict[str, Any]:

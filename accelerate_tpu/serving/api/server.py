@@ -10,11 +10,19 @@ Routes (all JSON unless noted):
 - ``DELETE /v1/requests/<id>`` — cancel by response id (``cmpl-…`` /
   ``chatcmpl-…`` / bare rid), queued or running.
 - ``GET /metrics`` | ``/healthz`` | ``/debug/flight`` | ``/debug/stacks`` |
-  ``/debug/requests[/<id>]`` — the telemetry surface, muxed onto this port
-  through the shared
+  ``/debug/requests[/<id>]`` | ``/debug/slo`` — the telemetry surface,
+  muxed onto this port through the shared
   :class:`~accelerate_tpu.telemetry.server.TelemetryEndpoints` (one process,
   one scrape target).  ``/healthz`` additionally aggregates per-replica
-  router health: any stuck replica flips it to 503.
+  router health: any stuck replica flips it to 503 (and, with
+  ``slo_healthz=True``, so does any fast-burning SLO).
+
+Tenant attribution: generation requests are attributed to a tenant taken
+from the ``X-Tenant`` header, falling back to the API-key prefix of an
+``Authorization: Bearer <tenant>-...`` token.  The resolved tenant rides
+:class:`CompletionCall` into the engine (per-tenant metric families) and is
+echoed back as ``X-Tenant`` on every response that carries
+``X-Request-Id``, so callers can verify which bucket they billed.
 
 Status mapping: malformed body → 400 (``invalid_request_error``); unknown
 model → 404; queue-full backpressure (retriable
@@ -34,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -69,6 +78,30 @@ __all__ = ["ApiServer"]
 
 #: Max accepted request body (token-id prompts are compact; 8 MiB is ample).
 MAX_BODY_BYTES = 8 << 20
+
+#: Tenant labels become metric-name segments (``serve/*_tenant_<t>_total``),
+#: so the charset is the metric-name charset — anything else is dropped
+#: rather than half-sanitized into a colliding label.
+_TENANT_RE = re.compile(r"[A-Za-z0-9_]{1,64}")
+
+
+def _tenant_from_headers(headers) -> Optional[str]:
+    """Resolve the tenant for one request from gateway-controlled headers.
+
+    ``X-Tenant`` wins; otherwise the prefix of an
+    ``Authorization: Bearer <tenant>-<secret>`` API key is used (the common
+    key-minting convention).  Returns ``None`` — unattributed — when neither
+    yields a well-formed label; never raises.
+    """
+    raw = headers.get("X-Tenant")
+    if raw and _TENANT_RE.fullmatch(raw.strip()):
+        return raw.strip().lower()
+    auth = headers.get("Authorization") or ""
+    if auth.startswith("Bearer "):
+        prefix = auth[len("Bearer "):].strip().split("-", 1)[0]
+        if prefix and _TENANT_RE.fullmatch(prefix):
+            return prefix.lower()
+    return None
 
 
 def _retry_after(seconds: float) -> str:
@@ -149,7 +182,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     "accelerate_tpu serving front door\n"
                     "endpoints: /v1/completions /v1/chat/completions "
                     "/v1/models /metrics /healthz /debug/flight "
-                    "/debug/stacks /debug/requests\n",
+                    "/debug/stacks /debug/requests /debug/slo\n",
                 )
             else:
                 code, ctype, body = api.endpoints.handle(parts.path, parts.query)
@@ -198,6 +231,9 @@ class _ApiHandler(BaseHTTPRequestHandler):
             else:
                 self._send(404, error_body("not found", "invalid_request_error"))
                 return
+            # attribution comes from headers, never the JSON body: the body
+            # is caller-controlled, the headers are gateway-controlled
+            call.tenant = _tenant_from_headers(self.headers)
             self._generate(call)
         except ValidationError as exc:
             self._send(400, error_body(str(exc), "invalid_request_error",
@@ -264,13 +300,16 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 f"generation failed: {stream.error!r}", "internal_error",
             ))
             return
+        headers = {"X-Request-Id": request_id}
+        if call.tenant is not None:
+            headers["X-Tenant"] = call.tenant
         self._send(200, completion_response(
             call, request_id, created, model, stream.final_tokens,
             eos_token_id=call.stop_token_id,
             cancelled=stream.final_state is not None
             and stream.final_state.name == "CANCELLED",
             decode=api.decode,
-        ), extra_headers={"X-Request-Id": request_id})
+        ), extra_headers=headers)
 
     def _stream_response(self, call: CompletionCall, rid: int, stream,
                          request_id: str, created: int, model: str) -> None:
@@ -282,6 +321,8 @@ class _ApiHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream; charset=utf-8")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("X-Request-Id", request_id)
+        if call.tenant is not None:
+            self.send_header("X-Tenant", call.tenant)
         self.send_header("Connection", "close")
         self.end_headers()
         self.close_connection = True
@@ -377,6 +418,10 @@ class ApiServer:
     chat_template: token-id chat template for ``/v1/chat/completions``.
     unhealthy_after_s: heartbeat staleness threshold for ``/healthz``.
     request_timeout_s: server-side cap on one generation (504 + cancel).
+    slo_healthz: opt-in — flip ``/healthz`` to 503 while any installed SLO
+        is fast-burning (both burn windows over threshold).  Off by default
+        because a load balancer draining a replica for an error-budget burn
+        is a policy decision, not a liveness fact.
     """
 
     def __init__(
@@ -390,6 +435,7 @@ class ApiServer:
         chat_template: Optional[ChatTemplate] = None,
         unhealthy_after_s: float = 60.0,
         request_timeout_s: float = 600.0,
+        slo_healthz: bool = False,
     ):
         self.frontdoor = frontdoor
         self.encode = encode
@@ -402,6 +448,7 @@ class ApiServer:
             registry=self.metrics,
             unhealthy_after_s=unhealthy_after_s,
             health_extra=self._router_health,
+            slo_healthz=slo_healthz,
         )
         self.http_requests = self.metrics.counter(
             "serve/http_requests_total",

@@ -72,6 +72,7 @@ from ..telemetry import (
     get_registry,
     get_reqtrace,
     get_tracer,
+    slo_tick,
     start_debug_server,
 )
 from . import faults
@@ -131,6 +132,10 @@ class _Stats(dict):
         engine = getattr(self, "engine", None)
         out["requests"] = (
             get_reqtrace().summary(engine_id=engine.engine_id)
+            if engine is not None else {}
+        )
+        out["tenants"] = (
+            {t: dict(v) for t, v in engine._tenant_stats.items()}
             if engine is not None else {}
         )
         return out
@@ -877,6 +882,17 @@ class ServingEngine:
         # per-traffic-class TTFT histograms, created lazily on the first
         # request carrying each class label (serve/ttft_s_class_<class>)
         self._class_ttft_hists: dict = {}
+        # tenant attribution: per-tenant counter/histogram families, created
+        # lazily on the first request carrying each tenant label (same
+        # pattern as the class hists) —
+        # serve/<key>_tenant_<tenant>_total and serve/ttft_s_tenant_<tenant>.
+        # ``_tenant_stats`` mirrors the bumps numerically so
+        # ``stats()["tenants"]`` is a lock-free rollup that sums EXACTLY to
+        # the global counters (every _bump_tenant site sits beside a _bump).
+        self._tenant_counters: dict = {}
+        self._tenant_ttft_hists: dict = {}
+        self._tenant_stats: dict = {}
+        self._tenant_kv_gauges: dict = {}
         self._token_hist = self.metrics.histogram(
             "serve/token_latency_s", buckets=_LATENCY_BUCKETS,
             help="inter-token wall time (first token = TTFT)",
@@ -1058,6 +1074,38 @@ class ServingEngine:
         self.stats[key] += n
         self._counters[key].inc(n)
 
+    def _bump_tenant(self, tenant: Optional[str], key: str, n: int = 1) -> None:
+        """Mirror a ``_bump`` into the caller tenant's lazily created counter
+        family (``serve/<key>_tenant_<tenant>_total``) and the numeric rollup
+        behind ``stats()["tenants"]``.  Steady-state cost is two dict lookups;
+        ``tenant=None`` (untenanted traffic) is one ``is None`` check."""
+        if tenant is None:
+            return
+        counters = self._tenant_counters.get(tenant)
+        if counters is None:
+            counters = self._tenant_counters[tenant] = {}
+            self._tenant_stats[tenant] = {}
+        counter = counters.get(key)
+        if counter is None:
+            counter = counters[key] = self.metrics.counter(
+                f"serve/{key}_tenant_{tenant}_total"
+            )
+            self._tenant_stats[tenant][key] = 0
+        self._tenant_stats[tenant][key] += n
+        counter.inc(n)
+
+    def _tenant_ttft(self, tenant: Optional[str], value: float) -> None:
+        """Per-tenant TTFT histogram family (``serve/ttft_s_tenant_<t>``),
+        created lazily like the per-class family."""
+        if tenant is None:
+            return
+        hist = self._tenant_ttft_hists.get(tenant)
+        if hist is None:
+            hist = self._tenant_ttft_hists[tenant] = self.metrics.histogram(
+                f"serve/ttft_s_tenant_{tenant}", buckets=_LATENCY_BUCKETS,
+            )
+        hist.observe(value)
+
     def _put(self, x):
         """Upload host data for a window call.  Under a mesh every control
         operand must be *replicated over the mesh's devices* — a plain
@@ -1086,6 +1134,7 @@ class ServingEngine:
         speculate: bool = True,
         deadline_s: Optional[float] = None,
         request_class: Optional[str] = None,
+        tenant: Optional[str] = None,
         **overrides: Any,
     ) -> Request:
         """Queue one request; returns its :class:`Request` handle (filled in
@@ -1103,7 +1152,13 @@ class ServingEngine:
         ``"batch"``): TTFT is additionally observed into a per-class
         histogram ``serve/ttft_s_class_<class>`` so one tenant's long
         prompts can't hide another's latency regression in the blended
-        percentile."""
+        percentile.  ``tenant`` attributes this request to a caller: every
+        global counter the request moves (submissions, tokens, preemptions,
+        sheds, completions, replays) is mirrored into
+        ``serve/<key>_tenant_<tenant>_total`` and the
+        ``stats()["tenants"]`` rollup, and TTFT additionally lands in
+        ``serve/ttft_s_tenant_<tenant>`` — the accounting substrate for
+        fair-share enforcement."""
         gen = config or GenerationConfig()
         if overrides:
             gen = dataclasses.replace(gen, **overrides)
@@ -1150,6 +1205,7 @@ class ServingEngine:
             est = self.scheduler.queue_depth * self._service_ema
             if est > float(deadline_s):
                 self._bump("deadline_shed")
+                self._bump_tenant(tenant, "deadline_shed")
                 self.recorder.record(
                     "serve/deadline_shed", where="admission",
                     deadline_s=float(deadline_s), estimate_s=est,
@@ -1167,7 +1223,7 @@ class ServingEngine:
                       submit_step=self._step_count, submit_time=now, last_token_time=now,
                       cache_prefix=bool(cache_prefix), speculate=bool(speculate),
                       deadline_s=None if deadline_s is None else float(deadline_s),
-                      request_class=request_class)
+                      request_class=request_class, tenant=tenant)
         self._next_rid += 1
         # the waterfall opens here: queue_wait runs until the first prefill
         # chunk is taken (None when tracing is off — every hook guards on it)
@@ -1177,6 +1233,7 @@ class ServingEngine:
         )
         self.scheduler.submit(req)
         self._bump("requests_submitted")
+        self._bump_tenant(tenant, "requests_submitted")
         if deadline_s is not None:
             self._has_deadlines = True
         return req
@@ -1428,6 +1485,10 @@ class ServingEngine:
         self.scheduler.requeue(request)
         self._bump("requests_submitted")
         self._bump("requests_replayed")
+        # the tenant label rides the Request across the failover — the
+        # adopting engine keeps the caller's books exact
+        self._bump_tenant(request.tenant, "requests_submitted")
+        self._bump_tenant(request.tenant, "requests_replayed")
         if request.deadline_s is not None:
             self._has_deadlines = True
         self.recorder.record(
@@ -1512,6 +1573,7 @@ class ServingEngine:
             req.state = RequestState.CANCELLED
             req.finish_step = self._step_count
             self._bump("deadline_shed")
+            self._bump_tenant(req.tenant, "deadline_shed")
             self.recorder.record(
                 "serve/deadline_shed", where="running", rid=req.rid, slot=s,
                 deadline_s=req.deadline_s, elapsed_s=elapsed,
@@ -1531,6 +1593,7 @@ class ServingEngine:
             self.scheduler.cancel(req.rid)
             req.deadline_exceeded = True
             self._bump("deadline_shed")
+            self._bump_tenant(req.tenant, "deadline_shed")
             self.recorder.record(
                 "serve/deadline_shed", where="queued", rid=req.rid,
                 deadline_s=req.deadline_s, elapsed_s=elapsed,
@@ -1931,6 +1994,7 @@ class ServingEngine:
             freed = self._retire_lane(s)
             self.scheduler.requeue(req)
             self._bump("preemptions")
+            self._bump_tenant(req.tenant, "preemptions")
             self.recorder.record(
                 "serve/preempt", rid=req.rid, slot=int(s), step=self._step_count,
                 pages_freed=freed, effective_len=eff,
@@ -2199,6 +2263,7 @@ class ServingEngine:
             else 0.8 * self._service_ema + 0.2 * dur
         )
         self._bump("requests_completed")
+        self._bump_tenant(req.tenant, "requests_completed")
         self.recorder.record(
             "serve/finish", rid=req.rid, slot=slot, step=self._step_count,
             tokens=len(req.tokens), steps=self._step_count - req.submit_step,
@@ -2843,6 +2908,7 @@ class ServingEngine:
                         )
                         self._class_ttft_hists[req.request_class] = hist
                     hist.observe(now - req.submit_time)
+                self._tenant_ttft(req.tenant, now - req.submit_time)
             for t in toks[s, :n]:
                 req.emit(int(t))
             if owner and self._draft_window is not None:
@@ -2850,6 +2916,7 @@ class ServingEngine:
                 # (the committed suffix ends with the next pending token)
                 self._draft_window.push(int(s), toks[s, :n])
             self._bump("tokens_generated", n)
+            self._bump_tenant(req.tenant, "tokens_generated", n)
             # a cycle lands n tokens on this lane at once: each is charged its
             # amortized share of the wall time since the lane's last arrival
             self._token_hist.observe(max(now - req.last_token_time, 0.0) / n, n)
@@ -2979,6 +3046,30 @@ class ServingEngine:
         return (self.scheduler.has_queued or bool(self._active.any())
                 or self._inflight is not None)
 
+    def _update_tenant_kv_gauges(self) -> None:
+        """Per-tenant KV occupancy gauges (``serve/kv_pages_tenant_<t>``):
+        pages held by each tenant's active lanes in paged mode, lanes held in
+        legacy slab mode.  Walks the slot array — metrics-tick cadence only,
+        never the per-step hot path.  A tenant with no live lane reads 0
+        (the gauge is not deleted: dashboards want the series to zero, not
+        vanish)."""
+        if not self._tenant_stats:
+            return
+        held: dict = {}
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if req is None or req.tenant is None:
+                continue
+            n = int(self.kv.lane_npages[s]) if self.paged else 1
+            held[req.tenant] = held.get(req.tenant, 0) + n
+        for tenant in self._tenant_stats:
+            gauge = self._tenant_kv_gauges.get(tenant)
+            if gauge is None:
+                gauge = self._tenant_kv_gauges[tenant] = self.metrics.gauge(
+                    f"serve/kv_pages_tenant_{tenant}"
+                )
+            gauge.set(held.get(tenant, 0))
+
     def _log_health(self, dt: float, d_tokens: int) -> None:
         """One-line serve-health summary (the ``metrics_interval`` heartbeat)."""
         queued = self.scheduler.queue_depth
@@ -3014,6 +3105,11 @@ class ServingEngine:
                 if now - last_log >= metrics_interval:
                     self._log_health(now - last_log,
                                      self.stats["tokens_generated"] - last_tokens)
+                    # the fleet-health layer rides the same tick: refresh the
+                    # per-tenant KV gauges, then sample/evaluate the SLO
+                    # engine if one is installed (a no-op branch otherwise)
+                    self._update_tenant_kv_gauges()
+                    slo_tick()
                     last_log = now
                     last_tokens = self.stats["tokens_generated"]
             if max_steps is not None and steps >= max_steps:

@@ -526,13 +526,24 @@ class ReplicaRouter:
 
     # ----------------------------------------------------------------- stats
     def stats(self) -> dict:
-        """Sum of every replica's ``stats`` dict, plus router counters."""
+        """Sum of every replica's ``stats`` dict, plus router counters and a
+        fleet-wide per-tenant rollup (each tenant's counters summed across
+        replicas — failover replays land on the adopting engine, so only the
+        cross-replica sum is the caller's true account)."""
         out: dict = {}
         for e in self.engines:
             for k, v in e.stats.items():
                 out[k] = out.get(k, 0) + v
         out["routed"] = self._routed
         out["affinity_hits"] = self._affinity_hits
+        tenants: dict = {}
+        for e in self.engines:
+            for tenant, counts in getattr(e, "_tenant_stats", {}).items():
+                agg = tenants.setdefault(tenant, {})
+                for k, v in counts.items():
+                    agg[k] = agg.get(k, 0) + v
+        if tenants:
+            out["tenants"] = tenants
         return out
 
     def prefix_cache_stats(self) -> dict:

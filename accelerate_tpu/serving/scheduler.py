@@ -95,6 +95,11 @@ class Request:
     # traffic-class label ("chat", "batch", ...) for per-class TTFT
     # histograms; None stays out of the per-class series entirely
     request_class: Optional[str] = None
+    # tenant attribution label (X-Tenant header / API-key prefix at the front
+    # door).  Rides the Request through preemption, export_inflight, and
+    # failover ``adopt`` exactly like ``trace`` does, so per-tenant counters
+    # stay exact across replays; None stays out of every per-tenant family
+    tenant: Optional[str] = None
     # per-request latency waterfall (telemetry.reqtrace.RequestTrace; None
     # when tracing is off).  The SAME object rides through preemption,
     # export_inflight, and failover adoption, so the waterfall spans replicas

@@ -24,7 +24,16 @@ Zero-dependency observability for the train and serve hot paths (see
   retention, served at ``/debug/requests[/<id>]``.
 * :mod:`.server` — opt-in stdlib HTTP daemon (``ATPU_METRICS_PORT``)
   serving ``/metrics``, ``/healthz``, ``/debug/flight``, ``/debug/stacks``,
-  ``/debug/requests``.
+  ``/debug/requests``, ``/debug/slo``.
+* :mod:`.timeseries` — bounded ring of registry snapshots sampled on the
+  serving loops' existing ticks; windowed counter rates and windowed
+  histogram quantiles from bucket deltas, with per-label family rollups.
+* :mod:`.slo` — declarative SLOs (availability / latency / throughput)
+  judged as multi-window burn rates over the ring store, exported as
+  ``serve/slo_burn_rate_<name>`` gauges and ``GET /debug/slo``.
+* :mod:`.diagnostics` — burn-triggered bundles: flight ring + stacks +
+  slowest-K waterfalls + the offending time-series window, written to
+  ``ATPU_FLIGHT_DIR`` (rate-limited by the SLO engine's cooldown).
 
 Everything is on by default and costs nanoseconds per observation;
 ``ATPU_TELEMETRY=0`` (or :func:`set_enabled` / ``get_tracer().enabled``)
@@ -39,6 +48,7 @@ from .cost import (
     HARDWARE_PEAKS,
     detect_device_peaks,
 )
+from .diagnostics import capture_bundle
 from .flight_recorder import (
     FlightRecorder,
     StallDetector,
@@ -71,6 +81,16 @@ from .server import (
     start_debug_server,
     stop_debug_server,
 )
+from .slo import (
+    SloEngine,
+    SloSpec,
+    default_specs,
+    get_slo_engine,
+    install_slos,
+    slo_tick,
+    uninstall_slos,
+)
+from .timeseries import TimeSeriesStore
 from .tracer import (
     Tracer,
     device_trace_active,
@@ -120,4 +140,13 @@ __all__ = [
     "get_debug_server",
     "stop_debug_server",
     "resolve_metrics_port",
+    "TimeSeriesStore",
+    "SloSpec",
+    "SloEngine",
+    "default_specs",
+    "install_slos",
+    "uninstall_slos",
+    "get_slo_engine",
+    "slo_tick",
+    "capture_bundle",
 ]

@@ -204,19 +204,26 @@ class FlightRecorder:
         logger.warning("\n".join(lines), main_process_only=False)
 
     def write_artifact(
-        self, dump: Dict[str, Any], directory: Optional[str] = None
+        self, dump: Dict[str, Any], directory: Optional[str] = None,
+        prefix: str = "flight",
     ) -> Optional[str]:
         """Write ``dump`` as JSON under ``directory`` (default:
         ``$ATPU_FLIGHT_DIR``). Returns the path, or ``None`` when no
-        directory is configured or the write fails."""
+        directory is configured or the write fails.  ``prefix`` names the
+        artifact kind — stall/crash dumps keep ``flight``; SLO diagnostic
+        bundles (:mod:`.diagnostics`) write ``slo`` so an operator can tell
+        the two apart in a shared directory."""
         directory = directory or os.environ.get(FLIGHT_DIR_ENV)
         if not directory:
             return None
         try:
             os.makedirs(directory, exist_ok=True)
-            path = os.path.join(
-                directory, f"flight-{os.getpid()}-{int(time.time() * 1000)}.json"
-            )
+            stem = f"{prefix}-{os.getpid()}-{int(time.time() * 1000)}"
+            path = os.path.join(directory, f"{stem}.json")
+            seq = 0
+            while os.path.exists(path):  # same-millisecond artifacts
+                seq += 1
+                path = os.path.join(directory, f"{stem}-{seq}.json")
             with open(path, "w") as fh:
                 json.dump(dump, fh, indent=1, default=repr)
             return path

@@ -204,6 +204,31 @@ class Histogram:
             cum += c
         return self._max
 
+    @property
+    def bounds(self) -> Tuple[float, ...]:
+        """Bucket upper bounds (ascending; the implicit +Inf bucket is last
+        in :meth:`bucket_counts` but carries no bound here)."""
+        return self._bounds
+
+    def bucket_counts(self) -> Tuple[int, ...]:
+        """Point-in-time per-bucket counts, ``len(bounds) + 1`` long (final
+        slot = +Inf overflow).  NON-cumulative, unlike the Prometheus
+        exposition — two snapshots subtract bucket-wise into a *windowed*
+        histogram, which is how :class:`~.timeseries.TimeSeriesStore`
+        computes a windowed p99 without ever resetting cumulative state."""
+        return tuple(self._counts)
+
+    def bucket_snapshot(self) -> Dict[str, Any]:
+        """Everything a windowed-delta consumer needs in one immutable grab:
+        bounds, per-bucket counts, total count, and sum.  Cumulative state is
+        untouched — the Prometheus exposition stays byte-identical."""
+        return {
+            "bounds": self._bounds,
+            "counts": tuple(self._counts),
+            "count": self._count,
+            "sum": self._sum,
+        }
+
     def snapshot(self) -> Dict[str, float]:
         if self._count == 0:
             return {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0, "max": 0.0,
@@ -297,6 +322,13 @@ class MetricsRegistry:
 
     def get(self, name: str):
         return self._metrics.get(name)
+
+    def items(self) -> List[Tuple[str, Any]]:
+        """Stable (name, metric) pairs — the iteration surface the
+        time-series sampler walks (a list copy, safe against concurrent
+        lazy-family registration)."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     # ------------------------------------------------------------ exporters
     def snapshot(self) -> Dict[str, Any]:

@@ -23,6 +23,9 @@ surface is constructed with ``Accelerator(metrics_port=...)`` /
 - ``GET /debug/requests/<id>`` — one request's phase waterfall, addressable
   by the ``X-Request-Id`` the API server emits (``cmpl-N`` / bare rid);
   ``?format=chrome`` returns a single-request Chrome-trace JSON instead.
+- ``GET /debug/slo`` — burn-rate verdicts for every installed SLO (see
+  :mod:`accelerate_tpu.telemetry.slo`); ``{"enabled": false}`` when no
+  engine is installed.
 
 The server is a ``ThreadingHTTPServer`` on a daemon thread: it dies with the
 process and never blocks shutdown. ``ATPU_TELEMETRY=0`` disables it
@@ -91,6 +94,10 @@ class TelemetryEndpoints:
     ``(healthy, details)`` merged into the ``/healthz`` body — the front
     door passes the router's per-replica aggregation, so a single stuck
     replica flips the endpoint to 503 even while others heartbeat.
+
+    ``slo_healthz`` (opt-in, default off) additionally flips ``/healthz``
+    to 503 while any installed SLO is fast-burning — for deployments whose
+    load balancer should drain a replica that is torching its error budget.
     """
 
     def __init__(
@@ -99,11 +106,13 @@ class TelemetryEndpoints:
         recorder: Optional[FlightRecorder] = None,
         unhealthy_after_s: float = 60.0,
         health_extra: Optional[Callable[[], Tuple[bool, Dict[str, Any]]]] = None,
+        slo_healthz: bool = False,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.recorder = recorder if recorder is not None else get_flight_recorder()
         self.unhealthy_after_s = float(unhealthy_after_s)
         self.health_extra = health_extra
+        self.slo_healthz = bool(slo_healthz)
         self._collectors: List[Callable[[], Any]] = []
 
     def add_collector(self, fn: Callable[[], Any]) -> None:
@@ -139,6 +148,14 @@ class TelemetryEndpoints:
                 extra_ok, extra = False, {"health_extra": "raised"}
             healthy = healthy and extra_ok
             body.update(extra)
+            body["healthy"] = healthy
+        if self.slo_healthz:
+            from .slo import get_slo_engine  # lazy: avoids an import cycle
+
+            engine = get_slo_engine()
+            burning = engine is not None and engine.any_fast_burning()
+            healthy = healthy and not burning
+            body["slo_fast_burning"] = burning
             body["healthy"] = healthy
         return healthy, body
 
@@ -180,6 +197,16 @@ class TelemetryEndpoints:
             return 200, "application/json", json.dumps(self.flight_tail(n), indent=1)
         if path == "/debug/stacks":
             return 200, "text/plain; charset=utf-8", self.render_stacks()
+        if path == "/debug/slo":
+            from .slo import get_slo_engine  # lazy: avoids an import cycle
+
+            engine = get_slo_engine()
+            if engine is None:
+                body: Dict[str, Any] = {"enabled": False, "slos": {}}
+            else:
+                body = {"enabled": True, "slos": engine.evaluate(),
+                        "bundles": list(engine.bundles)}
+            return 200, "application/json", json.dumps(body, indent=1)
         if path == "/debug/requests" or path == "/debug/requests/":
             return 200, "application/json", json.dumps(get_reqtrace().index(), indent=1)
         if path.startswith("/debug/requests/"):
@@ -210,7 +237,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "text/plain; charset=utf-8",
                     "accelerate_tpu debug server\n"
                     "endpoints: /metrics /healthz /debug/flight /debug/stacks "
-                    "/debug/requests /debug/requests/<id>\n",
+                    "/debug/requests /debug/requests/<id> /debug/slo\n",
                 )
             else:
                 code, ctype, body = debug.endpoints.handle(parts.path, parts.query)

@@ -1,8 +1,10 @@
 """metric-docs clean project: every registration documented, every doc row
-emitted (literally or via the f-string family)."""
+emitted (literally, via the f-string family, or via a `<...>` family row)."""
 
 
 def register(registry):
     registry.counter("train/steps_total", help="documented")
     for k in ("drafted", "accepted"):
         registry.counter(f"serve/{k}_total", help="dynamic family")
+    for t in ("acme", "umbrella"):
+        registry.gauge(f"serve/pages_tenant_{t}", help="documented family")

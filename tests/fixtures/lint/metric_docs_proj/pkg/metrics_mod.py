@@ -1,5 +1,7 @@
-"""metric-docs bad project: one undocumented registration (the gauge) and
-one orphan doc row (`serve/gone_gauge` in the doc's metric table)."""
+"""metric-docs bad project: one undocumented registration (the gauge), one
+orphan doc row (`serve/gone_gauge` in the doc's metric table), one
+undocumented f-string family (`serve/ttft_{tier}_hist`), and one orphan
+family doc row (`serve/kv_<tenant>_gauge` — nothing emits it)."""
 
 
 def register(registry):
@@ -7,3 +9,6 @@ def register(registry):
     registry.gauge("serve/queue_depth", help="NOT documented")
     for k in ("drafted", "accepted"):
         registry.counter(f"serve/{k}_total", help="dynamic family")
+    for tier in ("chat", "batch"):
+        registry.histogram(f"serve/ttft_{tier}_hist", help="NOT documented")
+        registry.histogram(f"serve/lat_{tier}_ms", help="documented family")

@@ -223,16 +223,25 @@ def test_metric_docs_both_directions():
     root = FIX / "metric_docs_proj"
     report = run_rules(["metric-docs"], ["pkg"], root=root)
     rendered = sorted(d.render() for d in report.diagnostics)
-    assert len(rendered) == 2, rendered
+    assert len(rendered) == 4, rendered
     # forward: registered but undocumented
-    assert "serve/queue_depth" in rendered[1] and "not documented" in rendered[1]
+    assert "serve/queue_depth" in rendered[3] and "not documented" in rendered[3]
+    # forward, family direction: an f-string registration with no doc row
+    # (concrete or `<...>` family) covering its pattern
+    assert "serve/ttft_<...>_hist" in rendered[2]
+    assert "family" in rendered[2] and "not documented" in rendered[2]
     # reverse (the fixed asymmetry): documented but no longer emitted —
     # reported against the doc, not a source file
     assert rendered[0].startswith("docs/usage/observability.md:")
     assert "orphan doc row" in rendered[0] and "serve/gone_gauge" in rendered[0]
-    # f-string families cover their concrete doc rows; `*` rows are patterns
+    # reverse, family direction: a `<...>` family row nothing registers
+    assert rendered[1].startswith("docs/usage/observability.md:")
+    assert "serve/kv_<tenant>_gauge" in rendered[1]
+    assert "family" in rendered[1]
+    # f-string families cover their concrete doc rows; `*` rows are globs;
+    # matched `<...>` family rows (`serve/lat_<tier>_ms`) are silent
     assert not any("serve/drafted_total" in r or "serve/decode_" in r
-                   for r in rendered)
+                   or "serve/lat_" in r for r in rendered)
 
 
 def test_metric_docs_clean():

@@ -17,11 +17,21 @@ metric name in the doc's metric table must still be emitted somewhere, or the
 row is an *orphan* that sends the dashboard builder hunting for a series that
 no longer exists.  A doc name counts as emitted when it matches a literal
 registration OR a dynamic f-string family (``f"serve/{k}_total"`` matches
-``serve/preemptions_total``).  Doc names carrying ``*`` or ``<`` are
-documented patterns and skipped; so are names outside the table's metrics
-column.  Span/flight-event names get the same orphan check against the doc's
+``serve/preemptions_total``).  Doc names carrying ``*`` are documented
+globs and skipped; so are names outside the table's metrics column.
+Span/flight-event names get the same orphan check against the doc's
 "Span & flight-event index" section: its table rows (first cell) must each
 match a ``span``/``record``/``heartbeat`` literal still in the tree.
+
+Families (per-tenant / per-class / per-SLO names) close the loop in both
+directions too.  A doc token written with ``<...>`` placeholders — e.g.
+``serve/ttft_s_tenant_<tenant>`` — is a *family row*: its placeholder-
+stripped instance (``serve/ttft_s_tenant_tenant``) must match some f-string
+registration pattern (``f"serve/ttft_s_tenant_{tenant}"``), or the family
+row is an orphan like any concrete row.  Conversely every f-string
+registration must be documented — once, as a family row (or by a concrete
+token the pattern covers); an undocumented ``f"serve/slo_burn_rate_{name}"``
+is exactly as invisible to the dashboard builder as an undocumented literal.
 
 Only string-literal (or f-string) first arguments are checked; names built
 from opaque variables are skipped, as are un-namespaced span names (no
@@ -37,7 +47,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from ..core import Diagnostic, Rule
 
@@ -53,9 +63,10 @@ class MetricDocsRule(Rule):
 
     def __init__(self):
         self._literals: List[Tuple[str, int, str, str]] = []  # rel, line, kind, name
-        self._patterns: List[re.Pattern] = []
+        # rel, line, kind, compiled pattern, display form (``serve/<...>_total``)
+        self._patterns: List[Tuple[str, int, str, re.Pattern, str]] = []
         self._event_literals: List[Tuple[str, int, str, str]] = []
-        self._event_patterns: List[re.Pattern] = []
+        self._event_patterns: List[Tuple[str, int, str, re.Pattern, str]] = []
 
     def applies_to(self, rel: str) -> bool:
         return rel.startswith("accelerate_tpu/")
@@ -76,7 +87,10 @@ class MetricDocsRule(Rule):
                 if isinstance(first, ast.Constant) and isinstance(first.value, str):
                     self._literals.append((ctx.rel, node.lineno, attr, first.value))
                 elif isinstance(first, ast.JoinedStr):
-                    self._patterns.append(self._joined_pattern(first))
+                    pattern, display = self._joined_pattern(first)
+                    self._patterns.append(
+                        (ctx.rel, node.lineno, attr, pattern, display)
+                    )
             elif attr in EVENT_EMITTERS:
                 if isinstance(first, ast.Constant) and isinstance(first.value, str):
                     # only namespaced names are part of the contract — bare
@@ -86,24 +100,48 @@ class MetricDocsRule(Rule):
                             (ctx.rel, node.lineno, attr, first.value)
                         )
                 elif isinstance(first, ast.JoinedStr):
-                    self._event_patterns.append(self._joined_pattern(first))
+                    pattern, display = self._joined_pattern(first)
+                    self._event_patterns.append(
+                        (ctx.rel, node.lineno, attr, pattern, display)
+                    )
         return []
 
     @staticmethod
-    def _joined_pattern(node: ast.JoinedStr) -> re.Pattern:
+    def _joined_pattern(node: ast.JoinedStr) -> Tuple[re.Pattern, str]:
+        """Compile an f-string registration into ``(match pattern, display)``
+        — the display form writes each interpolation as ``<...>``, the same
+        placeholder convention family rows use in the doc."""
         parts = []
+        display = []
         for piece in node.values:
             if isinstance(piece, ast.Constant):
                 parts.append(re.escape(str(piece.value)))
+                display.append(str(piece.value))
             else:
                 parts.append(r".+")
-        return re.compile("".join(parts))
+                display.append("<...>")
+        return re.compile("".join(parts)), "".join(display)
+
+    @staticmethod
+    def _family_instance(token: str) -> "Optional[str]":
+        """A doc token with ``<...>`` placeholders (``serve/ttft_s_tenant_
+        <tenant>``) collapses to a concrete *instance* (``serve/ttft_s_
+        tenant_tenant``) that f-string registration patterns can fullmatch.
+        Returns ``None`` for non-family tokens, globs, and malformed names.
+        """
+        if "<" not in token or "*" in token:
+            return None
+        instance = re.sub(r"<([a-z0-9_]+)>", r"\1", token)
+        if "<" in instance or ">" in instance:
+            return None
+        return instance if _CONCRETE.fullmatch(instance) else None
 
     def finalize(self, project) -> List[Diagnostic]:
         doc_rel = project.observability_doc
         doc_path = project.root / doc_rel
         if not doc_path.exists():
-            if not self._literals and not self._event_literals:
+            if not (self._literals or self._event_literals
+                    or self._patterns or self._event_patterns):
                 return []
             return [Diagnostic(doc_rel, 1, self.id, f"missing {doc_rel}")]
         doc_text = doc_path.read_text()
@@ -120,11 +158,58 @@ class MetricDocsRule(Rule):
                     rel, lineno, self.id,
                     f"{kind} event '{name}' is not documented in {doc_rel}",
                 ))
+        # forward, family direction: an f-string registration is documented
+        # when its pattern covers some backticked doc token — a concrete name
+        # or a ``<...>`` family row's placeholder-stripped instance.  Tokens
+        # are extracted per line: a whole-doc scan would mispair the
+        # backticks of ``` code fences with inline ones and shift every
+        # token after the first fence.
+        doc_tokens = set()
+        for doc_line in doc_text.splitlines():
+            if doc_line.lstrip().startswith("```"):
+                continue
+            doc_tokens.update(re.findall(r"`([^`]+)`", doc_line))
+        covered = {t for t in doc_tokens if _CONCRETE.fullmatch(t)}
+        covered.update(
+            inst for inst in map(self._family_instance, doc_tokens)
+            if inst is not None
+        )
+        for rel, lineno, kind, pattern, display in self._patterns:
+            if not any(pattern.fullmatch(t) for t in covered):
+                out.append(Diagnostic(
+                    rel, lineno, self.id,
+                    f"{kind} family '{display}' is not documented in "
+                    f"{doc_rel} (document it once as a family row, e.g. "
+                    f"`{display.replace('<...>', '<label>')}`)",
+                ))
+        for rel, lineno, kind, pattern, display in self._event_patterns:
+            if not any(pattern.fullmatch(t) for t in covered):
+                out.append(Diagnostic(
+                    rel, lineno, self.id,
+                    f"{kind} event family '{display}' is not documented in "
+                    f"{doc_rel}",
+                ))
         if not self._covers_package(project):
             return out
         emitted = {name for _, _, _, name in self._literals}
         for lineno, name in self._doc_table_names(doc_text):
-            if name in emitted or any(p.fullmatch(name) for p in self._patterns):
+            instance = self._family_instance(name)
+            if instance is not None:
+                if instance in emitted or any(
+                    p.fullmatch(instance) for _, _, _, p, _ in self._patterns
+                ):
+                    continue
+                out.append(Diagnostic(
+                    doc_rel, lineno, self.id,
+                    f"orphan doc row: metric family '{name}' is documented "
+                    "but no f-string registry.counter/gauge/histogram call "
+                    "emits it",
+                    src_line=name,
+                ))
+                continue
+            if name in emitted or any(
+                p.fullmatch(name) for _, _, _, p, _ in self._patterns
+            ):
                 continue
             out.append(Diagnostic(
                 doc_rel, lineno, self.id,
@@ -134,8 +219,22 @@ class MetricDocsRule(Rule):
             ))
         event_names = {name for _, _, _, name in self._event_literals}
         for lineno, name in self._event_index_names(doc_text):
+            instance = self._family_instance(name)
+            if instance is not None:
+                if instance in event_names or any(
+                    p.fullmatch(instance) for _, _, _, p, _ in self._event_patterns
+                ):
+                    continue
+                out.append(Diagnostic(
+                    doc_rel, lineno, self.id,
+                    f"orphan doc row: span/flight-event family '{name}' is "
+                    "documented but no f-string span/record/heartbeat call "
+                    "emits it",
+                    src_line=name,
+                ))
+                continue
             if name in event_names or any(
-                p.fullmatch(name) for p in self._event_patterns
+                p.fullmatch(name) for _, _, _, p, _ in self._event_patterns
             ):
                 continue
             out.append(Diagnostic(
@@ -165,10 +264,12 @@ class MetricDocsRule(Rule):
 
     @staticmethod
     def _doc_table_names(doc_text: str) -> List[Tuple[int, str]]:
-        """Concrete metric names in the metrics column (cell 2) of markdown
-        table rows.  Backticked tokens with ``*``/``<`` are documented
-        dynamic families, not concrete names.  Rows inside the span/event
-        index section belong to :meth:`_event_index_names`, not here."""
+        """Metric names in the metrics column (cell 2) of markdown table
+        rows: concrete names plus ``<...>`` family rows (orphan-checked
+        against f-string registrations via :meth:`_family_instance`).
+        Backticked tokens with ``*`` are documented globs and skipped.  Rows
+        inside the span/event index section belong to
+        :meth:`_event_index_names`, not here."""
         found = []
         in_event_section = False
         for i, line in enumerate(doc_text.splitlines(), start=1):
@@ -182,7 +283,11 @@ class MetricDocsRule(Rule):
                 continue
             for m in re.finditer(r"`([^`]+)`", cells[2]):
                 token = m.group(1)
-                if "*" in token or "<" in token:
+                if "*" in token:
+                    continue
+                if "<" in token:
+                    if MetricDocsRule._family_instance(token) is not None:
+                        found.append((i, token))
                     continue
                 if _CONCRETE.fullmatch(token):
                     found.append((i, token))
@@ -190,9 +295,10 @@ class MetricDocsRule(Rule):
 
     @staticmethod
     def _event_index_names(doc_text: str) -> List[Tuple[int, str]]:
-        """Concrete span/flight-event names from the doc's "Span &
-        flight-event index" section: the first backticked token of each table
-        row's first cell, until the next heading."""
+        """Span/flight-event names from the doc's "Span & flight-event
+        index" section: the backticked tokens of each table row's first
+        cell, until the next heading — concrete names plus ``<...>`` family
+        rows; ``*`` globs are skipped."""
         found = []
         in_section = False
         for i, line in enumerate(doc_text.splitlines(), start=1):
@@ -206,7 +312,11 @@ class MetricDocsRule(Rule):
                 continue
             for m in re.finditer(r"`([^`]+)`", cells[1]):
                 token = m.group(1)
-                if "*" in token or "<" in token:
+                if "*" in token:
+                    continue
+                if "<" in token:
+                    if MetricDocsRule._family_instance(token) is not None:
+                        found.append((i, token))
                     continue
                 if _CONCRETE.fullmatch(token):
                     found.append((i, token))
