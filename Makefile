@@ -59,6 +59,7 @@ bench-serve:
 	python bench_inference.py --task serve --chaos-ab
 	python bench_inference.py --task serve --trace-ab
 	python bench_inference.py --task serve --slo-ab
+	python bench_inference.py --task serve --disagg-ab
 	python bench_inference.py --task spec
 	python bench_inference.py --task spec --tree-ab
 

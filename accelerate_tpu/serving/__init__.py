@@ -29,6 +29,7 @@ from .prefix_cache import PrefixCache, PrefixNode, rolling_hash
 from .router import ReplicaRouter
 from .scheduler import Request, RequestState, Scheduler
 from .spec import propose_ngram_draft
+from .transfer import MigrationError, PageMigrator
 
 __all__ = [
     "ServingEngine",
@@ -37,6 +38,8 @@ __all__ = [
     "FaultInjected",
     "FaultInjector",
     "FaultPlan",
+    "MigrationError",
+    "PageMigrator",
     "ReplicaRouter",
     "ServeShardings",
     "Request",

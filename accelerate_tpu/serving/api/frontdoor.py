@@ -260,6 +260,24 @@ class FrontDoor:
             lambda: self.router.drain_replica(replica_id), admin=True
         )
 
+    def migrate_lane(
+        self,
+        from_replica: Optional[int] = None,
+        to_replica: Optional[int] = None,
+        slot: Optional[int] = None,
+        reason: str = "rebalance",
+    ) -> bool:
+        """Live-rebalance one running lane between replicas
+        (:meth:`ReplicaRouter.migrate_lane`) — an admin ticket, so the move
+        runs on the driver thread between steps, never mid-window."""
+        return self._call(
+            lambda: self.router.migrate_lane(
+                from_replica=from_replica, to_replica=to_replica,
+                slot=slot, reason=reason,
+            ),
+            admin=True,
+        )
+
     def lookup(self, rid: int) -> Optional[Tuple[Request, TokenStream]]:
         """Read-only peek at an outstanding request (DELETE-cancel routing).
         The tuple is a snapshot; only :class:`TokenStream` may be consumed

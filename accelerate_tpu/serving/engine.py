@@ -75,7 +75,7 @@ from ..telemetry import (
     slo_tick,
     start_debug_server,
 )
-from . import faults
+from . import faults, transfer
 from .errors import AdmissionError
 from .paging import DraftContextWindow, PagedKVPool
 from .pool import (
@@ -369,6 +369,7 @@ class ServingEngine:
         async_depth: int = 1,
         max_queue: Optional[int] = None,
         weights_version: str = "v0",
+        role: str = "both",
     ):
         cfg = model.config
         self.model = model
@@ -443,6 +444,21 @@ class ServingEngine:
                 "interleave_prefill needs the paged pool (the legacy batch-1 "
                 "prefill scratch admits one request at a time); pass paged=True"
             )
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got {role!r}"
+            )
+        if role != "both" and not self.paged:
+            raise ValueError(
+                "disaggregated roles move lanes between replicas as KV "
+                "pages; role='prefill'/'decode' requires paged=True"
+            )
+        #: "prefill" runs chunked prefill only — freshly installed lanes
+        #: never dispatch a decode window here, they wait for the router's
+        #: prefill handoff (serving/transfer.py) onto a decode-role peer.
+        #: "decode" replicas receive migrated lanes (and can still prefill
+        #: adopted replays — role shapes steady-state policy, not recovery).
+        self.role = role
         from ..ops.paged_attention import (
             kv_qmax,
             kv_storage_dtype,
@@ -1014,6 +1030,11 @@ class ServingEngine:
             help="info gauge: tensor-parallel degree the params and KV pool "
                  "shard over (1 = single-chip)",
         ).set(float(self.tp_degree))
+        self.metrics.gauge(
+            "serve/role",
+            help="info gauge: disaggregated serving role — 0 = both "
+                 "(monolithic), 1 = prefill-only, 2 = decode-only",
+        ).set({"both": 0.0, "prefill": 1.0, "decode": 2.0}[self.role])
         self._kv_quant_gauge = (
             self.metrics.gauge(
                 "serve/kv_quant_error",
@@ -1060,6 +1081,12 @@ class ServingEngine:
                  "dispatch); grows every step under async_depth=0, stays "
                  "near-flat once the depth-1 pipeline fills",
         )
+        # lane-migration gather/scatter pair, built lazily by
+        # serving/transfer.py on this engine's first migration (most
+        # replicas never migrate; the compiled budget grows only on the
+        # ones that do, by exactly this documented set)
+        self._migrate_extract: Optional[RecompileWatchdog] = None
+        self._migrate_install: Optional[RecompileWatchdog] = None
         # fault containment: the first exception to escape a step parks here
         # and every later step() re-raises it — a poisoned engine never
         # half-runs.  The router supervisor reads it to trigger ejection.
@@ -1385,117 +1412,21 @@ class ServingEngine:
         )
 
     def export_inflight(self) -> List[Request]:
-        """Snapshot every request this engine still owes an answer — running
-        lanes, the mid-prefill request, and the waiting queue — detached from
-        this engine's state and ready for :meth:`adopt` on a survivor.
-
-        Each RUNNING lane exports as ``prompt + generated-so-far`` via
-        ``Request.prefill_tokens`` (the preempt-and-replay machinery): replay
-        re-prefills the effective prompt and generation resumes exactly where
-        it stopped, token-exact under greedy.  Tokens already streamed are
-        never re-emitted.  Prefix-cache pins on THIS engine are released and
-        the per-engine prefill plan cleared — the adopting engine re-plans
-        against its own buckets and cache.  Device state is NOT touched (the
-        engine may be poisoned mid-window); :meth:`revive` handles teardown.
-        Returns requests in rid order — original FCFS submit order."""
-        out: List[Request] = []
-        for s in range(self.num_slots):
-            req = self._slot_req[s]
-            if req is not None and req.state is RequestState.RUNNING:
-                out.append(req)
-        for hd in (self._prev_handle, self._inflight):
-            if hd is None:
-                continue
-            # a pre-freed lane's request left _slot_req when its final window
-            # dispatched but is still owed that window's tokens from the
-            # drain this engine will never run — it lives only on the handle
-            for s in hd.prefreed:
-                req = hd.reqs[s]
-                if (req is not None and req.state is RequestState.RUNNING
-                        and not any(req is r for r in out)):
-                    out.append(req)
-        out.extend(self.scheduler.take_prefills())
-        out.extend(self.scheduler.queue)
-        self.scheduler.queue.clear()
-        for req in out:
-            if self.prefix_cache is not None and req.cache_nodes:
-                self.prefix_cache.release(req.cache_nodes)
-            req.cache_nodes = []
-            req.cached_chunks = 0
-            req.cache_chain_broken = False
-            req.chunks = ()
-            req.next_chunk = 0
-            req.slot = None
-            req.state = RequestState.QUEUED
-        out.sort(key=lambda r: r.rid)
-        for req in out:
-            if req.trace is not None:
-                req.trace.annotate("export_inflight", rid=req.rid,
-                                   generated=len(req.tokens))
-        self.recorder.record(
-            "serve/export_inflight", count=len(out), step=self._step_count,
-        )
-        return out
+        """Snapshot every request this engine still owes an answer, detached
+        and ready for :meth:`adopt` on a survivor.  The marshalling lives in
+        :func:`serving.transfer.export_inflight` — the state-movement module
+        shared with live page migration; this method is its engine-facing
+        entry point."""
+        return transfer.export_inflight(self)
 
     def adopt(self, request: Request) -> Request:
         """Admit a request exported from a dead replica, at the FRONT of the
-        queue (it already waited its FCFS turn once).  The effective prompt
-        is ``prefill_tokens`` — greedy lanes replay token-exact; sampled
-        lanes resume on a re-seeded stream (the fresh rid folds into this
-        engine's base rng at install), distribution-correct but not
-        sample-exact.  Raises a non-retriable :class:`AdmissionError` when
-        the effective prompt cannot fit this engine's geometry; never
-        refused for queue depth — survivors absorb a dead peer's load."""
-        eff = len(request.prefill_tokens)
-        if eff > self.max_prompt_len:
-            raise AdmissionError(
-                f"replayed prompt+generated length {eff} > max_prompt_len "
-                f"{self.max_prompt_len}",
-                queue_depth=self.scheduler.queue_depth, retriable=False,
-            )
-        span = max(self.window, self._spec_span)
-        remaining = max(request.config.max_new_tokens - len(request.tokens), 1)
-        if eff + remaining + span > self.max_len:
-            raise AdmissionError(
-                f"replayed length {eff} + remaining {remaining} + span {span} "
-                f"exceeds slot capacity {self.max_len}",
-                queue_depth=self.scheduler.queue_depth, retriable=False,
-            )
-        padded = sum(b for b, _ in plan_chunks(eff, self.buckets))
-        cap = self.max_len if self.paged else self.max_prompt_len
-        if padded > cap:
-            raise AdmissionError(
-                f"replayed length {eff} pads to {padded} prefill tokens under "
-                f"buckets {self.buckets}, exceeding capacity {cap}",
-                queue_depth=self.scheduler.queue_depth, retriable=False,
-            )
-        old_rid = request.rid
-        request.rid = self._next_rid
-        self._next_rid += 1
-        if request.trace is not None:
-            # the SAME trace crosses replicas: close the ejection-to-adoption
-            # interval as a failover phase and re-index under the new rid —
-            # the waterfall continues rather than restarting
-            request.trace.phase(
-                "failover", from_engine=request.trace.engine,
-                to_engine=self.engine_id, old_rid=old_rid, rid=request.rid,
-                generated=len(request.tokens),
-            )
-            self.reqtrace.rebind(request.trace, self.engine_id, request.rid)
-        self.scheduler.requeue(request)
-        self._bump("requests_submitted")
-        self._bump("requests_replayed")
-        # the tenant label rides the Request across the failover — the
-        # adopting engine keeps the caller's books exact
-        self._bump_tenant(request.tenant, "requests_submitted")
-        self._bump_tenant(request.tenant, "requests_replayed")
-        if request.deadline_s is not None:
-            self._has_deadlines = True
-        self.recorder.record(
-            "serve/adopt", rid=request.rid, old_rid=old_rid,
-            effective_len=eff, generated=len(request.tokens),
-        )
-        return request
+        queue.  Greedy lanes replay token-exact; sampled lanes resume on a
+        re-seeded stream (distribution-correct, not sample-exact — live
+        migration via :class:`serving.transfer.PageMigrator` is the
+        bit-identical alternative when the source's pages are readable).
+        The marshalling lives in :func:`serving.transfer.adopt`."""
+        return transfer.adopt(self, request)
 
     def revive(self) -> None:
         """Tear a poisoned engine back down to a serviceable idle state.
@@ -2971,7 +2902,17 @@ class ServingEngine:
         queue_depth = self.scheduler.queue_depth
         self._queue_gauge.set(queue_depth)
         self._prefree_exhausted()
-        if self.interleave_prefill:
+        if self.role == "prefill":
+            # disaggregated prefill replica: chunked prefill only.  Lanes
+            # whose last chunk landed sit installed-but-undecoded until the
+            # router hands them off to a decode replica (transfer.handoff);
+            # dispatching a decode window here would both waste the step and
+            # advance lanes the destination expects at their prefill
+            # frontier.  No window means nothing to charge decode for.
+            self._cycle_decode_tokens = 0
+            self._admit()
+            self._prev_handle = None
+        elif self.interleave_prefill:
             # decode-interleaved chunked prefill: dispatch this cycle's
             # window FIRST, then admit — the chunk enqueues *behind* the
             # window, so decode lanes never skip a cycle while a long
@@ -3200,7 +3141,12 @@ class ServingEngine:
         (``prefix_host_mb > 0``) adds exactly one ``spill_<bucket>`` D2H
         gather and one ``promote_<bucket>`` H2D scatter-install per prefill
         bucket — the documented, bounded growth of the compiled budget; each
-        stays 0 until the first spill/promotion of that bucket."""
+        stays 0 until the first spill/promotion of that bucket.  Live lane
+        migration adds exactly one ``migrate_extract`` D2H/D2D gather and one
+        ``migrate_install`` donated scatter at full ``pages_per_lane`` width
+        (page-id padding keeps the signature fixed) — built lazily by
+        ``serving.transfer.migration_executables``, so engines that never
+        participate in a migration gain neither entry."""
         out = {"decode_window": jit_cache_sizes(self._decode),
                "lane_install": jit_cache_sizes(self._lane_install)}
         if self.paged:
@@ -3220,4 +3166,8 @@ class ServingEngine:
             out[f"spill_{b}"] = jit_cache_sizes(f)
         for b, f in self._promote_install.items():
             out[f"promote_{b}"] = jit_cache_sizes(f)
+        if self._migrate_extract is not None:
+            out["migrate_extract"] = jit_cache_sizes(self._migrate_extract)
+        if self._migrate_install is not None:
+            out["migrate_install"] = jit_cache_sizes(self._migrate_install)
         return out

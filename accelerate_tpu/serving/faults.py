@@ -61,6 +61,8 @@ FAULT_POINTS = (
     "handler_disconnect", # break the SSE socket write (client vanished)
     "replica_kill",       # poison the busiest replica wholesale (router)
     "promote_h2d",        # raise before a spilled-prefix H2D promotion (engine)
+    "migrate_d2d",        # raise mid device-to-device page migration (transfer)
+    "migrate_bounce",     # raise mid pinned-host-bounce page migration (transfer)
 )
 
 

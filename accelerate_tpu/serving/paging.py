@@ -291,6 +291,13 @@ class PagedKVPool:
         (what the prefix cache retains for a freshly prefilled chunk)."""
         return [int(p) for p in self.tables[slot, start_page:start_page + n]]
 
+    def lane_pages(self, slot: int) -> List[int]:
+        """Every physical id the lane currently maps, in logical order —
+        the block-table row a migration marshals (the ids themselves stay
+        behind; only their *content* travels, into pages the destination
+        allocator hands out)."""
+        return self.chunk_ids(slot, 0, int(self.lane_npages[slot]))
+
     # ------------------------------------------------------------- accounting
     def kv_bytes(self) -> int:
         """Device HBM held by the page arrays (the whole pool, null included)."""

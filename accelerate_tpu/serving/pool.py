@@ -53,6 +53,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..models.generation import sample_tokens_batched
@@ -1183,6 +1184,24 @@ def make_promote_install(npages: int, shardings: Optional[ServeShardings] = None
         ),
         out_shardings=None if s is None else (s.kv, s.kv, s.scales, s.scales),
     )
+
+
+def pad_page_ids(ids: Sequence[int], npages: int) -> "np.ndarray":
+    """Pad a lane's live page-id list with ``NULL_PAGE`` up to a migration
+    executable's fixed ``npages`` width — the sanctioned bucket-padded
+    dispatch.  The null page is the pool's garbage sink: the migrate gather
+    reads finite (harmless) values from it for the padded rows, and the
+    migrate install scatters those padded rows back INTO it, where writes
+    are harmless by construction — so one compiled shape serves every
+    per-lane page count and nothing ever drifts the jit signature."""
+    if len(ids) > npages:
+        raise ValueError(
+            f"lane holds {len(ids)} pages, exceeding the executable's "
+            f"{npages}-page width"
+        )
+    out = np.full((npages,), NULL_PAGE, np.int32)
+    out[:len(ids)] = np.asarray(ids, np.int32)
+    return out
 
 
 def plan_chunks(prompt_len: int, buckets: Sequence[int]) -> Tuple[Tuple[int, int], ...]:

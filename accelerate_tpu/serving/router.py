@@ -19,6 +19,19 @@ matched token count; the best positive scorer wins, load breaking ties, and
 zero-scorers fall back to least-loaded.  Policy ``"round_robin"`` is the
 baseline A/B arm (``bench_inference.py --task serve --tp-ab``).
 
+Policy ``"disaggregated"`` splits the fleet by :class:`ServingEngine` role:
+new requests route (affinity-scored) to prefill-capable replicas only, and
+once a ``role="prefill"`` replica's last prompt chunk lands the router hands
+the lane off — live KV pages, block table, quant scales, RNG and pending
+state — to the least-loaded decode-capable replica via
+:class:`~accelerate_tpu.serving.transfer.PageMigrator` (device-to-device
+where platforms match, pinned-host bounce otherwise).  Decode continues
+bit-identically: the migrated lane produces the same tokens, greedy or
+sampled, it would have produced had it stayed put.  The same machinery backs
+:meth:`migrate_lane` (live rebalancing) and upgrades failover from
+re-prefill replay to migration while a dying replica's pages are still
+readable.  See ``docs/usage/serving.md`` ("Disaggregated prefill/decode").
+
 Failover: a replica that refuses a ``submit`` with an
 :class:`~accelerate_tpu.serving.errors.AdmissionError` — transient queue
 backpressure (``retriable=True``) or a capacity refusal such as a
@@ -65,8 +78,9 @@ from .engine import ServingEngine
 from .errors import AdmissionError
 from .pool import plan_chunks
 from .scheduler import Request, RequestState
+from .transfer import MigrationError, PageMigrator
 
-_POLICIES = ("affinity", "round_robin")
+_POLICIES = ("affinity", "round_robin", "disaggregated")
 
 
 class ReplicaRouter:
@@ -97,6 +111,23 @@ class ReplicaRouter:
             raise ValueError("ReplicaRouter needs at least one engine")
         if policy not in _POLICIES:
             raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
+        if policy == "disaggregated":
+            roles = [getattr(e, "role", "both") for e in engines]
+            if not any(r in ("prefill", "both") for r in roles):
+                raise ValueError(
+                    "disaggregated policy needs at least one prefill-capable "
+                    f"replica (role 'prefill' or 'both'); got roles {roles}"
+                )
+            if not any(r in ("decode", "both") for r in roles):
+                raise ValueError(
+                    "disaggregated policy needs at least one decode-capable "
+                    f"replica (role 'decode' or 'both'); got roles {roles}"
+                )
+            if not all(e.paged for e in engines):
+                raise ValueError(
+                    "disaggregated routing moves lanes between replicas as "
+                    "KV pages; every replica needs paged=True"
+                )
         self.engines: List[ServingEngine] = list(engines)
         # stable per-replica identities, parallel to ``engines``: positions
         # shift when an earlier replica detaches, ids never do
@@ -132,6 +163,24 @@ class ReplicaRouter:
             help="replicas ejected by the router supervisor after a poisoned "
                  "step (their in-flight requests replay on survivors)",
         )
+        # lazy: built on first handoff/migration so routers that never move
+        # a lane register no migration metrics
+        self._migrator: Optional[PageMigrator] = None
+
+    @property
+    def migrator(self) -> PageMigrator:
+        """The router's :class:`PageMigrator`, built on first use."""
+        if self._migrator is None:
+            self._migrator = PageMigrator(registry=self.metrics)
+        return self._migrator
+
+    @staticmethod
+    def _prefill_capable(engine: ServingEngine) -> bool:
+        return getattr(engine, "role", "both") in ("prefill", "both")
+
+    @staticmethod
+    def _decode_capable(engine: ServingEngine) -> bool:
+        return getattr(engine, "role", "both") in ("decode", "both")
 
     # ------------------------------------------------------------- placement
     def _load(self, engine: ServingEngine) -> int:
@@ -153,13 +202,17 @@ class ReplicaRouter:
 
     def _admittable(self, model_version: Optional[str] = None) -> List[int]:
         """Replica indices routing may place NEW requests on: not draining,
-        and — when the caller pinned a ``model_version`` — serving exactly
-        that weights label."""
+        — when the caller pinned a ``model_version`` — serving exactly that
+        weights label, and, under the disaggregated policy, prefill-capable
+        (every new request prefills before it decodes; decode-only replicas
+        receive their lanes by migration, never by submit)."""
         return [
             i for i in range(len(self.engines))
             if self._ids[i] not in self._draining
             and (model_version is None
                  or self.engines[i].weights_version == model_version)
+            and (self.policy != "disaggregated"
+                 or self._prefill_capable(self.engines[i]))
         ]
 
     def _choose(self, prompt: np.ndarray, candidates: Sequence[int]) -> tuple:
@@ -362,7 +415,157 @@ class ReplicaRouter:
             out[e.weights_version] = out.get(e.weights_version, 0) + 1
         return out
 
+    # ------------------------------------------------------- lane migration
+    def _pick_migration_dst(
+        self, src: ServingEngine
+    ) -> Optional[ServingEngine]:
+        """Least-loaded decode-capable replica whose pool geometry matches
+        ``src``'s, or None when nothing can receive a lane right now."""
+        cands = [
+            e for e in self.engines
+            if e is not src and e._poisoned is None
+            and self._decode_capable(e)
+            and self.migrator.compatible(src, e) is None
+        ]
+        if not cands:
+            return None
+        return min(cands, key=self._load)
+
+    def _fallback_replay(self, src: ServingEngine, req: Request) -> None:
+        """Migration's non-retriable fallback: retire the lane on ``src``
+        and replay the request (prompt + generated-so-far) on a survivor —
+        exactly the export/adopt path, for one lane.  Greedy lanes stay
+        token-exact; sampled lanes resume re-seeded."""
+        if req.slot is not None and src._slot_req[req.slot] is req:
+            src._retire_lane(req.slot)
+        if src.prefix_cache is not None and req.cache_nodes:
+            src.prefix_cache.release(req.cache_nodes)
+        req.cache_nodes = []
+        req.cached_chunks = 0
+        req.cache_chain_broken = False
+        req.chunks = ()
+        req.next_chunk = 0
+        req.slot = None
+        req.state = RequestState.QUEUED
+        self._replay_one(req)
+
+    def _sweep_handoffs(self) -> None:
+        """Disaggregated steady state: every installed lane on a
+        ``role="prefill"`` replica has its last prompt chunk landed (install
+        happens only then) and is waiting to decode somewhere else — hand
+        each off to the least-loaded decode-capable replica.  Destination
+        pressure (retriable :class:`MigrationError`) leaves the lane in
+        place for the next sweep; a non-retriable failure falls back to
+        single-lane replay so no request ever strands on a replica that
+        will never decode it."""
+        for src in list(self.engines):
+            if getattr(src, "role", "both") != "prefill":
+                continue
+            for s in range(src.num_slots):
+                req = src._slot_req[s]
+                if req is None or req.state is not RequestState.RUNNING:
+                    continue
+                dst = self._pick_migration_dst(src)
+                if dst is None:
+                    return  # no decode capacity anywhere; retry next step
+                try:
+                    self.migrator.handoff(src, dst, s)
+                except MigrationError as exc:
+                    if exc.retriable:
+                        continue
+                    self._fallback_replay(src, req)
+                else:
+                    i = self.engines.index(dst)
+                    req.replica = i
+                    req.replica_id = self._ids[i]
+
+    def migrate_lane(
+        self,
+        from_replica: Optional[int] = None,
+        to_replica: Optional[int] = None,
+        slot: Optional[int] = None,
+        reason: str = "rebalance",
+    ) -> bool:
+        """Live rebalancing: move one running lane between replicas without
+        interrupting its generation.  Replicas are named by stable id
+        (:meth:`replica_ids`).  Defaults pick the move a rebalancer wants:
+        the hottest source (by queued + active load, among replicas with a
+        running lane), its youngest lane (highest rid — least sunk decode
+        work behind it), and the coldest compatible decode-capable
+        destination.  Returns True when the lane left the source — migrated
+        bit-identically, or (non-retriable failure) replayed token-exact
+        under greedy; False when nothing could move (no source lane, no
+        destination, or a retriable refusal worth retrying later)."""
+        if from_replica is not None:
+            if from_replica not in self._ids:
+                raise ValueError(f"unknown replica id {from_replica}")
+            src = self.engines[self._ids.index(from_replica)]
+        else:
+            hot = [e for e in self.engines
+                   if any(r is not None and r.state is RequestState.RUNNING
+                          for r in e._slot_req)]
+            if not hot:
+                return False
+            src = max(hot, key=self._load)
+        if slot is None:
+            running = [(s, r) for s, r in enumerate(src._slot_req)
+                       if r is not None and r.state is RequestState.RUNNING]
+            if not running:
+                return False
+            slot = max(running, key=lambda sr: sr[1].rid)[0]
+        req = src._slot_req[slot]
+        if req is None:
+            return False
+        if to_replica is not None:
+            if to_replica not in self._ids:
+                raise ValueError(f"unknown replica id {to_replica}")
+            dst = self.engines[self._ids.index(to_replica)]
+        else:
+            dst = self._pick_migration_dst(src)
+            if dst is None:
+                return False
+        try:
+            self.migrator.migrate(src, dst, slot, reason=reason)
+        except MigrationError as exc:
+            if exc.retriable:
+                return False
+            self._fallback_replay(src, req)
+            return True
+        i = self.engines.index(dst)
+        req.replica = i
+        req.replica_id = self._ids[i]
+        return True
+
     # -------------------------------------------------------- fault recovery
+    def _migrate_off(self, engine: ServingEngine) -> None:
+        """Failover upgrade (disaggregated policy): while the dying
+        replica's pages are still readable, move its RUNNING lanes to
+        survivors bit-identically instead of replaying them.  The first
+        failure of any kind aborts the remaining attempts — a replica that
+        cannot be read coherently falls back to export/replay for
+        everything still on it (the lanes it keeps stay untouched, so the
+        fallback sees them exactly as a plain ejection would)."""
+        for s in range(engine.num_slots):
+            req = engine._slot_req[s]
+            if req is None or req.state is not RequestState.RUNNING:
+                continue
+            dst = self._pick_migration_dst(engine)
+            if dst is None:
+                return
+            try:
+                self.migrator.migrate(engine, dst, s, reason="failover")
+            except Exception as exc:
+                # the dying replica could not be read coherently (or the
+                # destination refused): record it and let the caller's
+                # export/replay pass take everything still on the engine
+                self.recorder.record(
+                    "serve/migrate_failover_abort", slot=s, error=repr(exc),
+                )
+                return
+            i = self.engines.index(dst)
+            req.replica = i
+            req.replica_id = self._ids[i]
+
     def _eject_and_replay(self, engine: ServingEngine, exc: BaseException) -> None:
         """Remove a dead replica and replay everything it owed on survivors.
 
@@ -378,6 +581,11 @@ class ReplicaRouter:
             return
         i = self.engines.index(engine)
         replica_id = self._ids[i]
+        if self.policy == "disaggregated" and len(self.engines) > 1:
+            # failover upgrade: lanes whose pages are still readable migrate
+            # bit-identically; export_inflight below picks up only what the
+            # migration pass could not move
+            self._migrate_off(engine)
         exported = engine.export_inflight()
         del self.engines[i]
         del self._ids[i]
@@ -400,8 +608,17 @@ class ReplicaRouter:
             self._replay_one(req)
 
     def _replay_one(self, req: Request) -> None:
+        pool = range(len(self.engines))
+        if self.policy == "disaggregated":
+            # replays re-prefill then decode on the adopting engine, so the
+            # adopter must be decode-capable (decode-role replicas prefill
+            # adopted replays: role shapes steady-state routing, not
+            # recovery); prefill-only replicas can never finish the request
+            capable = [i for i in pool
+                       if self._decode_capable(self.engines[i])]
+            pool = capable if capable else pool
         survivors = sorted(
-            range(len(self.engines)), key=lambda i: self._load(self.engines[i])
+            pool, key=lambda i: self._load(self.engines[i])
         )
         last_err: Optional[Exception] = None
         for i in survivors:
@@ -503,6 +720,11 @@ class ReplicaRouter:
                 engine.step()
             except Exception as exc:
                 self._eject_and_replay(engine, exc)
+        if self.policy == "disaggregated":
+            # after the replicas stepped: any lane whose final prompt chunk
+            # just landed on a prefill replica moves to a decode replica now,
+            # so its first decode window dispatches next step
+            self._sweep_handoffs()
         self._reap_drained()
         self._probe_breaker()
 
@@ -585,6 +807,7 @@ class ReplicaRouter:
                     "replica_id": self._ids[i],
                     "queue_depth": e.scheduler.queue_depth,
                     "active_lanes": int(e._active.sum()),
+                    "role": getattr(e, "role", "both"),
                     "tp_degree": e.tp_degree,
                     "has_work": e.has_work,
                     "draining": self._ids[i] in self._draining,

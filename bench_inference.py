@@ -3464,6 +3464,363 @@ def _hier_ab_bench(args, model, cfg, params, preset):
     }
 
 
+def _disagg_ab_bench(args, model, cfg, params, preset):
+    """Disaggregated prefill/decode A/B: role split + live KV page migration.
+
+    Four arms over greedy/sampled workloads, every check HARD (SystemExit):
+
+    * identity — the same submission order served by one monolithic engine
+      and by a ``policy="disaggregated"`` router (prefill replica + decode
+      replica, every lane handed off after its last prefill chunk) must
+      return bit-identical tokens, greedy AND sampled (the live RNG row
+      rides the migration), with one ``serve/prefill_handoffs_total`` per
+      request and ZERO decode steps on the prefill replica;
+    * crossover — migrate-vs-replay on a ladder of context lengths: move a
+      2-token-deep lane to a warm peer either by page migration or by the
+      failover replay path (export + adopt + re-prefill) and time until the
+      next token lands.  Replay cost grows with the context it re-prefills;
+      migration moves bytes.  The bench reports the crossover context
+      length and HARD-requires migration to win at the top of the ladder —
+      the regime ``migrate_lane()`` and failover-upgrade exist for;
+    * chat TTFT — the adversarial mix: a flood of long bulk prefills, then
+      short chat requests behind them.  Monolithic baseline: two
+      ``role="both"`` replicas under the affinity router, each interleaving
+      bulk prefill chunks with its decode windows.  Disaggregated arm: the
+      same two-engine footprint split prefill/decode (the decode replica
+      runs wider slots — it needs no prefill headroom; page pools are
+      unchanged).  Chat p99 TTFT must IMPROVE: that is the one number the
+      role split is for — decode windows never stall behind a bulk chunk,
+      prefill drains at full duty, and prefill-replica slots recycle at
+      handoff instead of being held through decode;
+    * kill — a prefill replica is poisoned mid-handoff with a spare
+      prefill-capable replica attached.  Zero failed requests: every
+      request must finish with tokens identical to the monolithic greedy
+      reference (readable pages migrate off the corpse; the rest replay).
+
+    ``value``/``vs_baseline`` is the chat-p99-TTFT improvement (monolithic
+    over disaggregated, > 1 is a win).  The compiled budget is gated too:
+    the migration pair appears ONLY on engines that migrated, at most once
+    each.
+    """
+    from accelerate_tpu.models.generation import GenerationConfig
+    from accelerate_tpu.serving import PageMigrator, ReplicaRouter, ServingEngine
+    from accelerate_tpu.telemetry import MetricsRegistry
+
+    params = jax.device_put(params)
+    window = args.decode_window
+    page = 4
+    mp = -(-max(16, min(args.seq, cfg.max_seq_len) * 3 // 4) // page) * page
+    buckets = tuple(sorted({max(8, -(-(mp // 4) // page) * page), mp}))
+    max_len = min((cfg.max_seq_len // page) * page,
+                  -(-(mp + 6 * window) // page) * page)
+    slots = max(2, min(args.batch, 4))
+    r = np.random.default_rng(args.serve_seed)
+
+    def build(role, n_slots, registry, win=None, **kw):
+        return ServingEngine(
+            model, params, num_slots=n_slots, max_len=max_len,
+            max_prompt_len=mp, prefill_buckets=buckets,
+            decode_window=window if win is None else win,
+            paged=True, page_size=page,
+            num_pages=2 * n_slots * (max_len // page) + 1,
+            prefix_cache_mb=0, async_depth=1, role=role, registry=registry,
+            max_queue=max(64, 8 * args.requests),
+            prefill_token_budget=buckets[0], **kw,
+        )
+
+    def prompt(n):
+        return r.integers(1, cfg.vocab_size, (int(n),)).astype(np.int32)
+
+    def gen(sampled, n):
+        if sampled:
+            return GenerationConfig(max_new_tokens=n, do_sample=True,
+                                    temperature=0.8, top_k=50,
+                                    eos_token_id=None)
+        return GenerationConfig(max_new_tokens=n, do_sample=False,
+                                eos_token_id=None)
+
+    # ---- arm 1: token identity vs the monolithic baseline, greedy + sampled
+    # fresh engines, no warmup: rid sequences must align between the mono
+    # engine and the prefill replica so the sampled streams fold identically
+    n_id = 6
+    id_prompts = [prompt(int(r.integers(4, mp))) for _ in range(n_id)]
+    id_gens = [gen(sampled=bool(k % 2), n=2 * window) for k in range(n_id)]
+    mono = build("both", 2 * slots, MetricsRegistry())
+    mono_reqs = mono.serve(id_prompts, id_gens)
+
+    reg_id = MetricsRegistry()
+    pre = build("prefill", slots, reg_id)
+    dec = build("decode", 2 * slots, reg_id)
+    dis = ReplicaRouter([pre, dec], policy="disaggregated", registry=reg_id)
+    dis_reqs = [dis.submit(p, config=g) for p, g in zip(id_prompts, id_gens)]
+    dis.run()
+    for k, (qm, qd) in enumerate(zip(mono_reqs, dis_reqs)):
+        if [int(t) for t in qm.tokens] != [int(t) for t in qd.tokens]:
+            raise SystemExit(
+                f"--disagg-ab identity: request {k} "
+                f"({'sampled' if k % 2 else 'greedy'}) diverged between the "
+                f"monolithic engine and the disaggregated split — migration "
+                f"is not bit-transparent"
+            )
+    handoffs = int(reg_id.get("serve/prefill_handoffs_total").value)
+    if handoffs != n_id:
+        raise SystemExit(
+            f"--disagg-ab identity: expected {n_id} prefill handoffs, "
+            f"recorded {handoffs} — lanes are not leaving the prefill replica"
+        )
+    if pre.stats["decode_steps"] != 0:
+        raise SystemExit(
+            f"--disagg-ab identity: the prefill replica ran "
+            f"{pre.stats['decode_steps']} decode steps; role='prefill' must "
+            "never decode"
+        )
+    for e, name, expect in ((pre, "prefill", "migrate_extract"),
+                            (dec, "decode", "migrate_install")):
+        counts = e.compiled_executable_counts()
+        if counts.get(expect) != 1:
+            raise SystemExit(
+                f"--disagg-ab budget: {name} replica compiled "
+                f"{expect}={counts.get(expect)} (want exactly 1 across "
+                f"{n_id} handoffs — fixed-width executables must not retrace)"
+            )
+    if set(mono.compiled_executable_counts()) & {"migrate_extract",
+                                                 "migrate_install"}:
+        raise SystemExit(
+            "--disagg-ab budget: the monolithic engine compiled migration "
+            "executables without ever migrating"
+        )
+
+    # ---- arm 2: migrate-vs-replay crossover over context length
+    ladder = sorted({4 * page, mp // 4, mp // 2, mp})
+    ladder = [-(-v // page) * page for v in ladder if v >= 2 * page]
+    migrator = PageMigrator(MetricsRegistry())
+    # a 2-token window keeps the lane shallow at migration time so the
+    # timed differential is transfer-vs-re-prefill, not decode headroom
+    src_m, dst_m, rep = (build("both", 2, MetricsRegistry(), win=2)
+                         for _ in range(3))
+    warm = [prompt(b) for b in buckets]
+    wgen = gen(False, window)
+
+    def slot_of(eng, req):
+        return next(s for s in range(eng.num_slots)
+                    if eng._slot_req[s] is req)
+
+    def migrate_time(L):
+        """Wall seconds from initiating the migration of a shallow lane with
+        ``L`` prompt tokens until its next token lands on ``dst_m``."""
+        req = src_m.submit(prompt(L), config=gen(False, 12))
+        while len(req.tokens) < 2:
+            src_m.step()
+        t0 = time.perf_counter()
+        migrator.migrate(src_m, dst_m, slot_of(src_m, req))
+        before = len(req.tokens)  # in-flight windows land during the drain
+        while len(req.tokens) <= before:
+            dst_m.step()
+        dt = time.perf_counter() - t0
+        dst_m.run()
+        src_m.run()
+        return dt
+
+    def replay_time(L):
+        """The failover-replay cost for the same lane: ``adopt`` re-prefills
+        ``prompt + generated`` (``Request.prefill_tokens``) on the survivor,
+        so time a fresh (L+2)-token submission until its first token —
+        identical work, without needing a corpse to export from."""
+        t0 = time.perf_counter()
+        req = rep.submit(prompt(min(L + 2, mp)), config=gen(False, 4))
+        while len(req.tokens) < 1:
+            rep.step()
+        dt = time.perf_counter() - t0
+        rep.run()
+        return dt
+
+    for e in (src_m, dst_m, rep):
+        e.serve(warm, wgen)
+    migrate_time(ladder[0])  # warm the migrate pair end to end
+
+    curve = []
+    for L in ladder:
+        dt_m = min(migrate_time(L) for _ in range(max(3, args.iters)))
+        dt_r = min(replay_time(L) for _ in range(max(3, args.iters)))
+        curve.append({"context": L + 2, "migrate_ms": round(1e3 * dt_m, 3),
+                      "replay_ms": round(1e3 * dt_r, 3)})
+    if curve[-1]["migrate_ms"] >= curve[-1]["replay_ms"]:
+        raise SystemExit(
+            f"--disagg-ab crossover: migration never beat replay — at "
+            f"context {curve[-1]['context']} migrate took "
+            f"{curve[-1]['migrate_ms']}ms vs replay "
+            f"{curve[-1]['replay_ms']}ms.  Curve: {curve}"
+        )
+    crossover = next(p["context"] for p in curve
+                     if p["migrate_ms"] < p["replay_ms"])
+
+    # ---- arm 3: chat p99 TTFT on the adversarial bulk-prefill + chat mix
+    # mix-local geometry: the disaggregation scenario is a chat arriving
+    # while bulk lanes are mid-decode, so bulk decode must be LONG relative
+    # to its prefill — a short window with all remaining slot capacity spent
+    # on decode.  The monolithic replicas hold a slot through prefill AND
+    # that whole decode; the split recycles prefill slots at handoff.
+    mw = min(4, window)
+    mpx = min(-(-max(4 * page, mp // 2) // page) * page, max_len - 8 * mw)
+    bx = tuple(sorted({max(8, -(-(mpx // 2) // page) * page), mpx}))
+    bulk_new = max_len - mpx - mw
+
+    def build_mix(role, n_slots, registry, budget=None):
+        # the prefill-token budget exists to protect decode latency from
+        # prefill interference; a prefill-only replica has no decode to
+        # protect, so it runs the full bucket per step
+        return ServingEngine(
+            model, params, num_slots=n_slots, max_len=max_len,
+            max_prompt_len=mpx, prefill_buckets=bx, decode_window=mw,
+            paged=True, page_size=page,
+            num_pages=2 * n_slots * (max_len // page) + 1,
+            prefix_cache_mb=0, async_depth=1, role=role, registry=registry,
+            max_queue=max(64, 8 * args.requests),
+            prefill_token_budget=bx[0] if budget is None else budget,
+        )
+
+    n_chat = 6
+    n_bulk = max(6, args.requests - n_chat)
+    bulk_prompts = [prompt(mpx) for _ in range(n_bulk)]
+    chat_prompts = [prompt(8) for _ in range(n_chat)]
+    bulk_gen, chat_gen = gen(False, bulk_new), gen(False, mw)
+    warm_x = [prompt(b) for b in bx]
+    wgen_x = gen(False, mw)
+    reps = max(2, args.iters // 2)
+
+    def run_mix(router, registry, engines):
+        for e in engines:  # compile everything outside the timed region
+            if getattr(e, "role", "both") != "prefill":
+                e.serve(warm_x, wgen_x)
+        if any(getattr(e, "role", "both") == "prefill" for e in engines):
+            for w in warm_x:
+                router.submit(w, config=wgen_x)
+            router.run()
+        for e in engines:
+            for k in e.stats:
+                e.stats[k] = 0
+        registry.reset()
+        toks = []
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            qs = [router.submit(p, config=bulk_gen, request_class="bulk")
+                  for p in bulk_prompts]
+            # chats arrive mid-burst, once half the bulk lanes are decoding
+            while sum(1 for q in qs if len(q.tokens) > 0) < n_bulk // 2:
+                router.step()
+            qs += [router.submit(p, config=chat_gen, request_class="chat")
+                   for p in chat_prompts]
+            router.run()
+            toks.append([[int(t) for t in q.tokens] for q in qs])
+        dt = time.perf_counter() - t0
+        p99 = registry.get("serve/ttft_s_class_chat").snapshot()["p99"]
+        return toks, dt, p99
+
+    reg_m = MetricsRegistry()
+    mono_engines = [build_mix("both", slots, reg_m) for _ in range(2)]
+    mono_router = ReplicaRouter(mono_engines, registry=reg_m)
+    mono_toks, dt_mono, p99_mono = run_mix(mono_router, reg_m, mono_engines)
+
+    reg_d = MetricsRegistry()
+    pre2 = build_mix("prefill", slots, reg_d, budget=bx[-1])
+    dec2 = build_mix("decode", 4 * slots, reg_d)
+    dis2 = ReplicaRouter([pre2, dec2], policy="disaggregated",
+                         registry=reg_d)
+    dis_toks, dt_dis, p99_dis = run_mix(dis2, reg_d, (pre2, dec2))
+
+    if dis_toks != mono_toks:
+        raise SystemExit(
+            "--disagg-ab mix: greedy tokens diverged between the "
+            "disaggregated split and the monolithic router on the same "
+            "workload"
+        )
+    improvement = p99_mono / p99_dis if p99_dis > 0 else float("inf")
+    if p99_dis >= p99_mono:
+        raise SystemExit(
+            f"--disagg-ab TTFT: chat p99 TTFT did not improve under the "
+            f"disaggregated split — {1e3 * p99_dis:.2f}ms vs "
+            f"{1e3 * p99_mono:.2f}ms monolithic on the bulk-prefill + chat "
+            "mix"
+        )
+
+    # ---- arm 4: prefill replica killed mid-handoff — zero failed requests
+    n_k = max(4, min(8, args.requests // 2))
+    k_prompts = [prompt(mp) for _ in range(n_k)]
+    k_gen = gen(False, 2 * window)
+    ref = [[int(t) for t in q.tokens]
+           for q in mono.serve(k_prompts, [k_gen] * n_k)]
+
+    reg_k = MetricsRegistry()
+    kills = [build("prefill", slots, reg_k), build("prefill", slots, reg_k),
+             build("decode", 2 * slots, reg_k)]
+    kr = ReplicaRouter(kills, policy="disaggregated", registry=reg_k,
+                       breaker_base_s=3600.0)
+    kr.migrator  # materialize the migration counters before polling them
+    kreqs = [kr.submit(p, config=k_gen) for p in k_prompts]
+    victim, steps = None, 0
+    while victim is None:
+        kr.step()
+        steps += 1
+        # mid-handoff: at least one lane already crossed to the decode
+        # replica and the victim still owns work (mid-prefill lanes, lanes
+        # awaiting the sweep, or queue) — the full failover ladder fires
+        if int(reg_k.get("serve/prefill_handoffs_total").value) >= 1:
+            busy = [e for e in kills[:2] if e.has_work]
+            if busy:
+                victim = max(busy, key=lambda e: sum(
+                    q is not None for q in e._slot_req))
+        if victim is None and steps > 300:
+            raise SystemExit("--disagg-ab kill: never caught a prefill "
+                             "replica mid-handoff; workload too small")
+    victim.kill("disagg-ab: injected prefill replica loss")
+    kr.run()
+    got = [[int(t) for t in q.tokens] for q in kreqs]
+    failed = [k for k, (g, want) in enumerate(zip(got, ref)) if g != want]
+    if failed:
+        raise SystemExit(
+            f"--disagg-ab kill: {len(failed)}/{n_k} requests failed or "
+            f"diverged after the prefill replica died mid-handoff "
+            f"(first: request {failed[0]}, got {got[failed[0]][:6]}... want "
+            f"{ref[failed[0]][:6]}...)"
+        )
+    k_migrated = int(reg_k.get("serve/migrations_total").value)
+    k_replayed = kr.stats().get("requests_replayed", 0)
+
+    detail = {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "page_size": page,
+        "prefill_buckets": list(buckets),
+        "decode_window": window,
+        "slots_monolithic": [slots, slots],
+        "slots_disaggregated": {"prefill": slots, "decode": 2 * slots},
+        "identity_requests": n_id,
+        "prefill_handoffs": handoffs,
+        "outputs_token_identical": True,
+        "crossover_context_tokens": crossover,
+        "migrate_vs_replay_curve": curve,
+        "mix": {
+            "bulk_requests": reps * n_bulk, "chat_requests": reps * n_chat,
+            "bulk_prompt_len": mpx, "bulk_new_tokens": bulk_new,
+            "decode_window": mw, "chat_prompt_len": 8,
+            "decode_slots": 4 * slots,
+            "chat_ttft_p99_ms_monolithic": round(1e3 * p99_mono, 2),
+            "chat_ttft_p99_ms_disaggregated": round(1e3 * p99_dis, 2),
+            "wall_s_monolithic": round(dt_mono, 3),
+            "wall_s_disaggregated": round(dt_dis, 3),
+        },
+        "kill": {"requests": n_k, "failed": 0, "migrated_off": k_migrated,
+                 "replayed": k_replayed, "steps_before_kill": steps},
+    }
+    return {
+        "metric": "serving_disagg_chat_ttft_p99_improvement",
+        "value": round(improvement, 3),
+        "unit": "x",
+        "vs_baseline": round(improvement, 3),
+        "detail": detail,
+    }
+
+
 def _serve_bench(args, model, cfg, params, preset):
     """Continuous batching vs static ``generate`` on one mixed-length workload.
 
@@ -3492,13 +3849,17 @@ def _serve_bench(args, model, cfg, params, preset):
             bool(getattr(args, "slo_ab", False)),
             bool(getattr(args, "prefill_ab", False)),
             bool(getattr(args, "hier_ab", False)),
+            bool(getattr(args, "disagg_ab", False)),
             bool(args.shared_prefix)]) > 1:
         raise SystemExit("--paged-ab, --kernel-ab, --tp-ab, --async-ab, "
                          "--http-ab, --chaos-ab, --trace-ab, --slo-ab, "
-                         "--prefill-ab, --hier-ab and --shared-prefix are "
-                         "separate serve workloads; pick one")
+                         "--prefill-ab, --hier-ab, --disagg-ab and "
+                         "--shared-prefix are separate serve workloads; "
+                         "pick one")
     if getattr(args, "paged_ab", False):
         return _paged_ab_bench(args, model, cfg, params, preset)
+    if getattr(args, "disagg_ab", False):
+        return _disagg_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "hier_ab", False):
         return _hier_ab_bench(args, model, cfg, params, preset)
     if getattr(args, "http_ab", False):
@@ -3773,6 +4134,16 @@ def main():
                              "mix whose working set is ~10x prefix_cache_mb — "
                              "token-identity, host hit rate > 0, tokens/s >= "
                              "1.25x, mean-TTFT, overlap, atpu-lint, and "
+                             "executable-budget hard checks")
+    parser.add_argument("--disagg-ab", dest="disagg_ab", action="store_true",
+                        help="--task serve: A/B disaggregated prefill/decode "
+                             "(role split + live KV page migration) against "
+                             "the monolithic router — token identity greedy "
+                             "AND sampled, a migrate-vs-replay crossover "
+                             "curve (migration must win at the top), chat "
+                             "p99 TTFT improvement on the adversarial "
+                             "bulk-prefill + chat mix, zero failed requests "
+                             "when a prefill replica dies mid-handoff, and "
                              "executable-budget hard checks")
     parser.add_argument("--kv-dtype", dest="kv_dtype", choices=["int8", "fp8"],
                         default="int8",

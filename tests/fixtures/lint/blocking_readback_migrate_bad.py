@@ -1,0 +1,15 @@
+"""blocking-readback (lane migration): eager syncs on the migration gather's
+handles — two flagged lines (device_get call, block_until_ready call) —
+stalling the source's other lanes on every migration instead of letting the
+gather ride the dispatch queue (d2d) or using the one sanctioned fetch
+(bounce)."""
+import jax
+
+
+def gather_lane(extract, kv, ids, pending):
+    ck, cv, cks, cvs = extract(
+        kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, ids)
+    host_k = jax.device_get(ck)
+    cvs.block_until_ready()
+    pending.append((host_k, cv, cks, cvs))
+    return pending

@@ -1,0 +1,24 @@
+"""implicit-host-sync (lane migration, d2d arm): the migration gather's
+outputs converted host-side before the destination install — four violations
+(np.asarray x2, truth-test, int) — instead of feeding the device handles
+straight to the install (d2d) or going through the one sanctioned blocking
+fetch (bounce)."""
+import numpy as np
+
+
+class Migrator:
+    def __init__(self, npages):
+        self._extract = _serve_jit(  # noqa: F821 — fixture stub
+            make_spill_extract(npages),  # noqa: F821 — fixture stub
+        )
+
+    def gather_lane(self, lane):
+        kv = self.src.kv
+        ids = self._put(np.asarray(lane.pages, np.int32))
+        ck, cv, cks, cvs = self._extract(
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales, ids)
+        host_k = np.asarray(ck)
+        host_v = np.asarray(cv)
+        if cks.any():
+            lane.scale_hint = int(cvs[0, 0, 0])
+        return host_k, host_v

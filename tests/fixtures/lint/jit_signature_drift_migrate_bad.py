@@ -1,0 +1,34 @@
+"""jit-signature-drift (lane migration): the one-per-engine extract/install
+pair fed call-varying shapes — three violations (the gathered chunk sliced by
+the lane's drifting page count, a page-id constructor sized by it, the
+drifting count itself passed positionally as the ids argument).  The final
+call is the repo's actual idiom — page ids padded with NULL_PAGE up to the
+pool's fixed ``pages_per_lane`` width — and must stay unflagged."""
+import jax.numpy as jnp
+
+
+class Migrator:
+    def __init__(self, pages_per_lane, page_size):
+        self._install = {
+            pages_per_lane: _serve_jit(  # noqa: F821 — fixture stub
+                make_promote_install(pages_per_lane),  # noqa: F821
+            ),
+        }
+
+    def migrate(self, lane, chunk, kv, ids):
+        n = len(lane.pages)
+        bad_slice = self._install[16](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k[:n], chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        bad_pad = self._install[16](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            jnp.zeros(n, jnp.int32), chunk.v, chunk.k_scales, chunk.v_scales,
+            ids)
+        bad_ids = self._install[16](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k, chunk.v, chunk.k_scales, chunk.v_scales, n)
+        good = self._install[16](
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            pad_to_bucket(chunk.k, 16),  # noqa: F821 — fixture stub
+            chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        return bad_slice, bad_pad, bad_ids, good

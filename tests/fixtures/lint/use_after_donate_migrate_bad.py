@@ -1,0 +1,29 @@
+"""use-after-donate (migration H2D install): the destination-side
+scatter-install donates all four pool arrays — two violations: a read of the
+donated ``kv.pages_k`` after dispatch (``migrate_then_audit``), and the
+donate-and-rebind in ``install_lane`` dropping the destination's old pool
+handles without parking them while its in-flight decode window may still
+consume them."""
+
+
+class Migrator:
+    def __init__(self, npages):
+        self._install = _serve_jit(  # noqa: F821 — fixture stub
+            make_promote_install(npages),  # noqa: F821 — fixture stub
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    def install_lane(self, chunk, ids):
+        kv = self.dst.kv
+        kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales = self._install(
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k, chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        return kv
+
+    def migrate_then_audit(self, chunk, ids):
+        kv = self.dst.kv
+        new_k, new_v, new_ks, new_vs = self._install(
+            kv.pages_k, kv.pages_v, kv.k_scales, kv.v_scales,
+            chunk.k, chunk.v, chunk.k_scales, chunk.v_scales, ids)
+        stale = kv.pages_k.sum()
+        return new_k, new_v, new_ks, new_vs, stale

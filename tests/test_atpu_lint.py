@@ -219,6 +219,57 @@ def test_jit_signature_drift_tree_verify():
     assert "passed positionally" in msgs
 
 
+def test_use_after_donate_migrate_install():
+    """The migration scatter-install donates the destination's four pool
+    arrays: reading a donated handle afterwards and the unparked
+    donate-and-rebind each fire — either regression would stall or corrupt
+    the destination's in-flight decode window."""
+    report = run_rules(["use-after-donate"],
+                       ["use_after_donate_migrate_bad.py"])
+    assert len(report.diagnostics) == 2, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "'kv.pages_k' was donated" in msgs and "read here" in msgs
+    assert "donate-and-rebind" in msgs and "park the old" in msgs
+
+
+def test_jit_signature_drift_migrate_executables():
+    """The migration extract/install pair fed per-lane page counts fires
+    three ways; the sanctioned NULL_PAGE-padded full-width dispatch stays
+    unflagged — the discipline that keeps migration to one compiled shape
+    per engine."""
+    report = run_rules(["jit-signature-drift"],
+                       ["jit_signature_drift_migrate_bad.py"])
+    assert len(report.diagnostics) == 3, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "sliced by a call-varying bound" in msgs
+    assert "zeros(...) sized by a call-varying" in msgs
+    assert "passed positionally" in msgs
+
+
+def test_implicit_host_sync_migrate_path():
+    """Materializing the migration gather's outputs host-side on the d2d arm
+    fires four ways; the sanctioned arms (device handles straight to the
+    install, or the one blocking fetch on the bounce) have no conversion to
+    flag."""
+    report = run_rules(["implicit-host-sync"],
+                       ["implicit_host_sync_migrate_bad.py"])
+    assert len(report.diagnostics) == 4, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "np.asarray() on a device value" in msgs
+    assert "truth-testing a device value" in msgs
+    assert "int() on a device value" in msgs
+
+
+def test_blocking_readback_migrate_path():
+    """Eager syncs on the migration gather's handles — device_get plus
+    block_until_ready — are both flagged."""
+    report = run_rules(["blocking-readback"],
+                       ["blocking_readback_migrate_bad.py"])
+    assert len(report.diagnostics) == 2, [d.render() for d in report.diagnostics]
+    msgs = " ".join(d.message for d in report.diagnostics)
+    assert "device_get" in msgs and "block_until_ready" in msgs
+
+
 def test_metric_docs_both_directions():
     root = FIX / "metric_docs_proj"
     report = run_rules(["metric-docs"], ["pkg"], root=root)
